@@ -1,0 +1,35 @@
+"""accelerate_tpu — a TPU-native training/inference framework with the
+capabilities of HuggingFace Accelerate, built directly on JAX/XLA.
+
+Reference: wonkyoc/accelerate (HF Accelerate 0.32.0.dev0). See SURVEY.md.
+"""
+
+__version__ = "0.1.0"
+
+from .logging import get_logger
+from .state import AcceleratorState, GradientState, PartialState
+from .utils import (
+    DataLoaderConfiguration,
+    DistributedType,
+    GradientAccumulationPlugin,
+    MixedPrecisionPolicy,
+    ParallelismPlugin,
+    ProjectConfiguration,
+    ShardingStrategy,
+    set_seed,
+)
+
+__all__ = [
+    "AcceleratorState",
+    "GradientState",
+    "PartialState",
+    "get_logger",
+    "DataLoaderConfiguration",
+    "DistributedType",
+    "GradientAccumulationPlugin",
+    "MixedPrecisionPolicy",
+    "ParallelismPlugin",
+    "ProjectConfiguration",
+    "ShardingStrategy",
+    "set_seed",
+]

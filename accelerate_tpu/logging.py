@@ -1,0 +1,65 @@
+"""Rank-aware logging.
+
+Parity: reference ``src/accelerate/logging.py`` — ``MultiProcessAdapter``:22
+(`main_process_only`/`in_order` kwargs), ``get_logger``:85,
+``warning_once``:74.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+from typing import Optional
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """Logs only on main process unless ``main_process_only=False`` is
+    passed; ``in_order=True`` serializes output process by process."""
+
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        from .state import PartialState
+
+        state = PartialState()
+        return not main_process_only or state.is_main_process
+
+    def log(self, level, msg, *args, **kwargs):
+        if os.environ.get("ACCELERATE_TPU_DISABLE_LOGGING", "false").lower() in (
+            "1",
+            "true",
+        ):
+            return
+        from .state import PartialState
+
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        kwargs.setdefault("stacklevel", 2)
+
+        if self.isEnabledFor(level):
+            if self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+            elif in_order:
+                state = PartialState()
+                for i in range(state.num_processes):
+                    if i == state.process_index:
+                        msg, kwargs = self.process(msg, kwargs)
+                        self.logger.log(level, msg, *args, **kwargs)
+                    state.wait_for_everyone()
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        """Emit a given warning only once per process (reference :74)."""
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: Optional[str] = None) -> MultiProcessAdapter:
+    """Reference logging.py:85."""
+    logger = logging.getLogger(name)
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_TPU_LOG_LEVEL", None)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

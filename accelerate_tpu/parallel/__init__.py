@@ -1,0 +1,7 @@
+from .mesh import (
+    build_mesh,
+    data_axes,
+    mesh_axis_size,
+    resolve_mesh_shape,
+    single_device_mesh,
+)

@@ -1,0 +1,83 @@
+"""Device-mesh construction and axis conventions.
+
+This is the seat of all parallelism topology. The reference has no analogue
+— its topology lives inside external engines (torch.distributed process
+groups, Megatron mpu: reference utils/megatron_lm.py:880) — here a single
+named :class:`jax.sharding.Mesh` with axes ``(dp, fsdp, ep, sp, tp)`` carries
+every strategy, and GSPMD lowers shardings over it to ICI/DCN collectives.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..utils.constants import MESH_AXES
+from ..utils.dataclasses import ParallelismPlugin
+
+
+def resolve_mesh_shape(
+    plugin: ParallelismPlugin, num_devices: int
+) -> dict[str, int]:
+    """Resolve ``-1`` (auto) axes against the real device count and validate
+    that the axis product covers all devices."""
+    shape = dict(plugin.mesh_shape)
+    fixed = math.prod(v for v in shape.values() if v != -1)
+    if num_devices % fixed != 0:
+        raise ValueError(
+            f"mesh degrees {shape} (product {fixed}) do not divide device count {num_devices}"
+        )
+    auto_axes = [k for k, v in shape.items() if v == -1]
+    if auto_axes:
+        shape[auto_axes[0]] = num_devices // fixed
+    elif fixed != num_devices:
+        raise ValueError(
+            f"mesh degrees {shape} use {fixed} devices but {num_devices} are present; "
+            "set one axis to -1 to auto-absorb"
+        )
+    return shape
+
+
+def build_mesh(
+    plugin: Optional[ParallelismPlugin] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global device mesh.
+
+    Axis order is outermost-first: ``dp`` varies slowest so that, on
+    multi-slice topologies, data-parallel collectives are the ones crossing
+    DCN while ``tp``/``sp`` (which move activations every layer) stay on the
+    innermost, fastest ICI ring.
+    """
+    plugin = plugin or ParallelismPlugin()
+    if devices is None:
+        devices = jax.devices()
+    shape = resolve_mesh_shape(plugin, len(devices))
+    dims = tuple(shape[a] for a in MESH_AXES)
+    device_array = np.asarray(devices).reshape(dims)
+    return Mesh(device_array, MESH_AXES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    """A trivial 1-device mesh so the same sharded code paths run everywhere."""
+    device = device or jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape((1,) * len(MESH_AXES)), MESH_AXES)
+
+
+def mesh_axis_size(mesh: Mesh, *axes: str) -> int:
+    return math.prod(mesh.shape[a] for a in axes)
+
+
+def data_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Mesh axes over which the global batch is sharded: every axis that is
+    not tensor/sequence-parallel acts as a data axis (standard FSDP batch
+    layout: batch shards over dp x fsdp x ep)."""
+    from ..utils.constants import MESH_AXIS_DATA, MESH_AXIS_EXPERT, MESH_AXIS_FSDP
+
+    return tuple(
+        a for a in (MESH_AXIS_DATA, MESH_AXIS_FSDP, MESH_AXIS_EXPERT) if mesh.shape[a] > 1
+    ) or (MESH_AXIS_DATA,)

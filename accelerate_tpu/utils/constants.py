@@ -1,0 +1,45 @@
+"""Framework-wide naming and versioning constants.
+
+Parity: reference ``src/accelerate/utils/constants.py`` (MODEL_NAME,
+SAFE_WEIGHTS_NAME, sharding-strategy tables). Here the checkpoint formats are
+TPU-native: Orbax/tensorstore sharded array checkpoints plus msgpack for small
+host-side state.
+"""
+
+MODEL_NAME = "model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+RNG_STATE_NAME = "random_states"
+CUSTOM_STATE_NAME = "custom_checkpoint"
+TRAIN_STATE_NAME = "train_state"
+
+SAFE_WEIGHTS_NAME = "model.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+WEIGHTS_NAME = "model.msgpack"
+WEIGHTS_INDEX_NAME = "model.msgpack.index.json"
+
+CONFIG_NAME = "accelerate_tpu_config.yaml"
+DEFAULT_CONFIG_DIR = "~/.cache/accelerate_tpu"
+
+# Mesh axis naming convention used across the whole framework. Order matters:
+# outer-to-inner device placement (dp outermost so DCN traffic rides the
+# data axis; tp innermost so its collectives stay on the fastest ICI links).
+MESH_AXIS_DATA = "dp"
+MESH_AXIS_FSDP = "fsdp"
+MESH_AXIS_EXPERT = "ep"
+MESH_AXIS_SEQUENCE = "sp"
+MESH_AXIS_TENSOR = "tp"
+MESH_AXES = (
+    MESH_AXIS_DATA,
+    MESH_AXIS_FSDP,
+    MESH_AXIS_EXPERT,
+    MESH_AXIS_SEQUENCE,
+    MESH_AXIS_TENSOR,
+)
+
+# Env-var transport prefix (reference uses ACCELERATE_*; we keep the same
+# convention so launch -> worker config flows through the environment).
+ENV_PREFIX = "ACCELERATE_TPU_"
+
+CHECKPOINT_DIR_PREFIX = "checkpoint"

@@ -1,0 +1,115 @@
+"""Environment-variable parsing and hardware probing.
+
+Parity: reference ``src/accelerate/utils/environment.py`` (str_to_bool:58,
+parse_flag_from_env:82, get_gpu_info:115) — rebuilt for the JAX/TPU stack:
+the hardware probes ask the JAX runtime about TPU topology instead of
+pynvml/CUDA.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any
+
+
+def str_to_bool(value: str) -> int:
+    """Convert a case-insensitive truthy/falsy string to 1/0."""
+    value = value.lower()
+    if value in ("y", "yes", "t", "true", "on", "1"):
+        return 1
+    if value in ("n", "no", "f", "false", "off", "0"):
+        return 0
+    raise ValueError(f"invalid truth value {value!r}")
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    value = os.environ.get(key, str(default))
+    try:
+        return bool(str_to_bool(value))
+    except ValueError:
+        raise ValueError(f"If set, {key} must be yes/no/true/false, got {value!r}.")
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    return os.environ.get(key, str(default))
+
+
+def get_int_from_env(keys: list[str], default: int) -> int:
+    """Return the first integer found among ``keys`` in the environment."""
+    for key in keys:
+        val = int(os.environ.get(key, -1))
+        if val >= 0:
+            return val
+    return default
+
+
+@contextmanager
+def patch_environment(**kwargs: Any):
+    """Temporarily set environment variables (reference utils/other.py:246).
+
+    Keys are upper-cased; ``None`` removes the variable.
+    """
+    existing = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        existing[key] = os.environ.get(key)
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key, old in existing.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
+@contextmanager
+def clear_environment():
+    """Temporarily clear the whole environment (reference utils/other.py:211)."""
+    saved = os.environ.copy()
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+
+
+def get_tpu_info() -> dict[str, Any]:
+    """Probe TPU topology from the live JAX runtime.
+
+    TPU-native replacement for the reference's ``get_gpu_info``
+    (utils/environment.py:115): reports device kind, chip counts, and
+    process layout rather than CUDA properties.
+    """
+    import jax
+
+    devices = jax.devices()
+    local = jax.local_devices()
+    kinds = sorted({d.device_kind for d in devices})
+    return {
+        "platform": jax.default_backend(),
+        "device_kind": kinds[0] if len(kinds) == 1 else kinds,
+        "num_devices": len(devices),
+        "num_local_devices": len(local),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+    }
+
+
+def get_hbm_bytes_per_device(default: int = 16 * 1024**3) -> int:
+    """Best-effort HBM size of the first local device in bytes."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return default

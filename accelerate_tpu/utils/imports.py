@@ -1,0 +1,131 @@
+"""Optional-dependency capability probes.
+
+Parity: reference ``src/accelerate/utils/imports.py`` (~50 ``is_*_available``
+functions gating every optional integration). The TPU build's hard deps are
+jax/flax/optax; everything else (orbax, tensorboard, wandb, torch, grain,
+datasets, safetensors, native extension) is probed here and gated at use
+sites.
+"""
+
+from __future__ import annotations
+
+import importlib.metadata
+import importlib.util
+from functools import lru_cache
+
+
+def _is_package_available(pkg_name: str) -> bool:
+    if importlib.util.find_spec(pkg_name) is None:
+        return False
+    try:
+        importlib.metadata.version(pkg_name)
+        return True
+    except importlib.metadata.PackageNotFoundError:
+        # Namespace packages (e.g. orbax) have a spec but no top-level dist.
+        return importlib.util.find_spec(pkg_name) is not None
+
+
+@lru_cache
+def is_orbax_available() -> bool:
+    return importlib.util.find_spec("orbax") is not None
+
+
+@lru_cache
+def is_tensorboard_available() -> bool:
+    return (
+        _is_package_available("tensorboard")
+        or _is_package_available("tensorboardX")
+        or importlib.util.find_spec("torch.utils.tensorboard") is not None
+    )
+
+
+@lru_cache
+def is_wandb_available() -> bool:
+    return _is_package_available("wandb")
+
+
+@lru_cache
+def is_mlflow_available() -> bool:
+    return _is_package_available("mlflow")
+
+
+@lru_cache
+def is_comet_ml_available() -> bool:
+    return _is_package_available("comet_ml")
+
+
+@lru_cache
+def is_clearml_available() -> bool:
+    return _is_package_available("clearml")
+
+
+@lru_cache
+def is_aim_available() -> bool:
+    return _is_package_available("aim")
+
+
+@lru_cache
+def is_dvclive_available() -> bool:
+    return _is_package_available("dvclive")
+
+
+@lru_cache
+def is_torch_available() -> bool:
+    return _is_package_available("torch")
+
+
+@lru_cache
+def is_safetensors_available() -> bool:
+    return _is_package_available("safetensors")
+
+
+@lru_cache
+def is_datasets_available() -> bool:
+    return _is_package_available("datasets")
+
+
+@lru_cache
+def is_transformers_available() -> bool:
+    return _is_package_available("transformers")
+
+
+@lru_cache
+def is_grain_available() -> bool:
+    return _is_package_available("grain")
+
+
+@lru_cache
+def is_rich_available() -> bool:
+    return _is_package_available("rich")
+
+
+@lru_cache
+def is_tqdm_available() -> bool:
+    return _is_package_available("tqdm")
+
+
+@lru_cache
+def is_yaml_available() -> bool:
+    return importlib.util.find_spec("yaml") is not None
+
+
+@lru_cache
+def is_pallas_available() -> bool:
+    """Whether jax.experimental.pallas imports on this install."""
+    try:
+        import jax.experimental.pallas  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+@lru_cache
+def is_native_runtime_available() -> bool:
+    """Whether the C++ runtime extension (data pipeline / allocator) built."""
+    try:
+        from accelerate_tpu import _native  # noqa: F401
+
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,103 @@
+"""Deterministic RNG across processes.
+
+Parity: reference ``src/accelerate/utils/random.py`` (`set_seed`:31,
+`synchronize_rng_states`:122 — rank-0 state broadcast). TPU-native redesign:
+JAX PRNG is a *value*, not ambient state, so determinism is the default —
+every process derives the same fold-in chain from one seed. What remains is
+(a) seeding python/numpy for host-side code (shuffles, augmentation), and
+(b) a key registry the Accelerator threads through dataloaders/steps and
+checkpoints.
+"""
+
+from __future__ import annotations
+
+import os
+import random as _py_random
+from typing import Any, Iterable, Optional
+
+import jax
+import numpy as np
+
+from .dataclasses import RNGType
+
+
+def set_seed(seed: int, device_specific: bool = False) -> jax.Array:
+    """Seed python, numpy and return a fresh root JAX key (reference :31).
+
+    With ``device_specific`` the seed is folded with the process index so
+    host-side augmentation differs per process while model init (which should
+    use the returned key pre-fold) stays identical.
+    """
+    if device_specific:
+        seed += jax.process_index()
+    _py_random.seed(seed)
+    np.random.seed(seed % (2**32))
+    os.environ["PYTHONHASHSEED"] = str(seed)
+    return jax.random.key(seed)
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator: Any = None):
+    """Force all processes to the main process's RNG state (reference :64).
+
+    python/numpy states are host objects -> broadcast via object collective.
+    JAX keys are already deterministic; a passed ``generator`` key is
+    broadcast for parity.
+    """
+    from .operations import broadcast_object_list
+
+    if rng_type in (RNGType.PYTHON, None):
+        state = broadcast_object_list([_py_random.getstate()])[0]
+        _py_random.setstate(state)
+    if rng_type in (RNGType.NUMPY, None):
+        state = broadcast_object_list([np.random.get_state()])[0]
+        np.random.set_state(state)
+    if rng_type in (RNGType.JAX, RNGType.GENERATOR) and generator is not None:
+        from .operations import broadcast
+
+        data = jax.random.key_data(generator)
+        synced = broadcast(np.asarray(data))
+        return jax.random.wrap_key_data(np.asarray(synced))
+    return generator
+
+
+def synchronize_rng_states(
+    rng_types: Iterable[str | RNGType], generator: Any = None
+):
+    """Reference :122."""
+    for rng_type in rng_types:
+        result = synchronize_rng_state(RNGType(str(rng_type)), generator)
+        if result is not None:
+            generator = result
+    return generator
+
+
+class KeyChain:
+    """Splittable key stream: a tiny stateful convenience over jax.random so
+    imperative user code can draw keys like the reference draws from torch
+    generators. The current key is checkpointable state."""
+
+    def __init__(self, seed_or_key: int | jax.Array = 0):
+        if isinstance(seed_or_key, int):
+            self._key = jax.random.key(seed_or_key)
+        else:
+            self._key = seed_or_key
+
+    def next_key(self, n: Optional[int] = None):
+        if n is None:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return list(subs)
+
+    def fold_in(self, data: int) -> jax.Array:
+        return jax.random.fold_in(self._key, data)
+
+    @property
+    def key(self) -> jax.Array:
+        return self._key
+
+    def state_dict(self) -> dict:
+        return {"key_data": np.asarray(jax.random.key_data(self._key))}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._key = jax.random.wrap_key_data(np.asarray(state["key_data"]))

@@ -1,0 +1,35 @@
+"""Test environment: a virtual 8-device CPU backend.
+
+This is the TPU build's equivalent of the reference's gloo/CPU debug
+launcher (reference launchers.py:263, SURVEY §4 pattern 2): real XLA
+collectives over 8 fake host devices so every sharding/mesh/collective path
+runs anywhere. The axon sitecustomize forces ``jax_platforms=axon,cpu`` at
+interpreter start, so we must override via jax.config (env vars are too
+late), before any backend initializes.
+"""
+
+import os
+
+os.environ.setdefault("ACCELERATE_TPU_TEST_NUM_DEVICES", "8")
+
+import jax
+
+if os.environ.get("ACCELERATE_TPU_TEST_ON_TPU", "0") != "1":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_num_cpu_devices", int(os.environ["ACCELERATE_TPU_TEST_NUM_DEVICES"])
+    )
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def reset_singletons():
+    """Reference AccelerateTestCase (test_utils/testing.py:429) resets
+    singleton state between tests; we do it for every test."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()

@@ -1,0 +1,124 @@
+"""Tests for pytree utilities + host-level collectives (reference
+test_utils/scripts/test_ops.py + tests/test_utils.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils import (
+    broadcast,
+    broadcast_object_list,
+    concatenate,
+    convert_to_fp32,
+    find_batch_size,
+    gather,
+    gather_object,
+    get_data_structure,
+    initialize_tensors,
+    pad_across_processes,
+    pad_input_tensors,
+    recursively_apply,
+    reduce,
+    send_to_device,
+    slice_tensors,
+)
+
+
+def test_recursively_apply_nested():
+    data = {"a": jnp.ones(3), "b": [jnp.zeros(2), "keep"], "c": (1, jnp.ones(1))}
+    out = recursively_apply(lambda x: x + 1, data)
+    assert float(out["a"][0]) == 2.0
+    assert out["b"][1] == "keep"
+    assert out["c"][0] == 1
+
+
+def test_send_to_device():
+    batch = {"x": np.ones((4, 2), dtype=np.float32), "y": np.zeros(4)}
+    out = send_to_device(batch, jax.devices()[0])
+    assert isinstance(out["x"], jax.Array)
+    assert out["x"].devices() == {jax.devices()[0]}
+
+
+def test_send_to_device_skip_keys():
+    batch = {"x": np.ones(2), "meta": np.zeros(2)}
+    out = send_to_device(batch, jax.devices()[0], skip_keys=["meta"])
+    assert isinstance(out["meta"], np.ndarray)
+
+
+def test_data_structure_roundtrip():
+    data = {"a": jnp.ones((2, 3)), "b": [jnp.zeros(5, dtype=jnp.int32)]}
+    structure = get_data_structure(data)
+    empty = initialize_tensors(structure)
+    assert empty["a"].shape == (2, 3)
+    assert empty["b"][0].dtype == jnp.int32
+
+
+def test_find_batch_size():
+    assert find_batch_size({"x": jnp.ones((7, 2))}) == 7
+    assert find_batch_size({"x": 3}) is None
+
+
+def test_slice_concat():
+    data = {"x": jnp.arange(10)}
+    sliced = slice_tensors(data, slice(0, 4))
+    assert sliced["x"].shape == (4,)
+    merged = concatenate([sliced, sliced])
+    assert merged["x"].shape == (8,)
+
+
+def test_convert_to_fp32():
+    data = {"a": jnp.ones(2, dtype=jnp.bfloat16), "b": jnp.ones(2, dtype=jnp.int32)}
+    out = convert_to_fp32(data)
+    assert out["a"].dtype == jnp.float32
+    assert out["b"].dtype == jnp.int32
+
+
+def test_gather_single_process_sharded_array():
+    """gather on a globally-sharded array returns the full array."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu import AcceleratorState
+
+    state = AcceleratorState()
+    x = jax.device_put(
+        jnp.arange(16.0).reshape(8, 2), NamedSharding(state.mesh, P("dp", None))
+    )
+    gathered = gather(x)
+    assert gathered.shape == (8, 2)
+    np.testing.assert_allclose(np.asarray(gathered), np.arange(16.0).reshape(8, 2))
+
+
+def test_gather_object_single():
+    assert gather_object({"k": 1}) == [{"k": 1}]
+
+
+def test_broadcast_single():
+    x = {"a": jnp.ones(3)}
+    out = broadcast(x)
+    np.testing.assert_allclose(np.asarray(out["a"]), 1.0)
+
+
+def test_broadcast_object_list_single():
+    objs = ["a", 1]
+    assert broadcast_object_list(objs) == ["a", 1]
+
+
+def test_reduce_single():
+    out = reduce(jnp.ones(3), "sum")
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+def test_pad_input_tensors():
+    batch = {"x": jnp.ones((5, 2))}
+    out = pad_input_tensors(batch, batch_size=5, num_processes=4)
+    assert out["x"].shape == (8, 2)
+    # padded rows repeat the last row
+    np.testing.assert_allclose(
+        np.asarray(out["x"][5:]), np.tile(np.asarray(out["x"][4:5]), (3, 1))
+    )
+
+
+def test_pad_across_processes_single_noop():
+    x = jnp.ones((3, 2))
+    assert pad_across_processes(x) is x

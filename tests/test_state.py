@@ -1,0 +1,99 @@
+"""Tests for state singletons + mesh construction.
+
+Mirrors reference tests/test_state_checkpointing.py's singleton behavior and
+test_utils/scripts/test_script.py's process checks, adapted to the JAX
+single-controller model.
+"""
+
+import jax
+import pytest
+
+from accelerate_tpu import (
+    AcceleratorState,
+    GradientState,
+    ParallelismPlugin,
+    PartialState,
+    ShardingStrategy,
+)
+from accelerate_tpu.parallel import build_mesh, resolve_mesh_shape
+from accelerate_tpu.utils import DistributedType
+
+
+def test_partial_state_singleton():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+    assert a.num_processes == 1
+    assert a.is_main_process
+    assert a.num_devices == 8
+    assert a.distributed_type in (DistributedType.CPU, DistributedType.TPU)
+
+
+def test_wait_for_everyone_noop():
+    PartialState().wait_for_everyone()
+
+
+def test_split_between_processes_single():
+    state = PartialState()
+    with state.split_between_processes([1, 2, 3]) as chunk:
+        assert chunk == [1, 2, 3]
+
+
+def test_accelerator_state_mesh_default_dp():
+    state = AcceleratorState()
+    assert dict(state.mesh.shape) == {"dp": 8, "fsdp": 1, "ep": 1, "sp": 1, "tp": 1}
+    assert state.data_parallel_size == 8
+
+
+def test_accelerator_state_mesh_hybrid():
+    plugin = ParallelismPlugin(dp_size=-1, fsdp_size=2, tp_size=2)
+    state = AcceleratorState(parallelism_plugin=plugin)
+    assert dict(state.mesh.shape) == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+    assert state.data_parallel_size == 4  # dp * fsdp
+
+
+def test_accelerator_state_delegates_to_partial():
+    state = AcceleratorState()
+    assert state.is_main_process
+    assert state.num_processes == 1
+
+
+def test_resolve_mesh_shape_errors():
+    with pytest.raises(ValueError):
+        resolve_mesh_shape(ParallelismPlugin(dp_size=3, fsdp_size=1), 8)
+    with pytest.raises(ValueError):
+        resolve_mesh_shape(ParallelismPlugin(dp_size=2, fsdp_size=2), 8)
+    shape = resolve_mesh_shape(ParallelismPlugin(dp_size=-1, tp_size=4), 8)
+    assert shape["dp"] == 2 and shape["tp"] == 4
+
+
+def test_gradient_state():
+    gs = GradientState()
+    assert gs.sync_gradients
+    assert gs.num_steps == 1
+    assert gs.remainder == -1
+    from accelerate_tpu import GradientAccumulationPlugin
+
+    GradientState._reset_state()
+    gs = GradientState(GradientAccumulationPlugin(num_steps=4))
+    assert gs.num_steps == 4
+
+
+def test_mixed_precision_state():
+    import jax.numpy as jnp
+
+    state = AcceleratorState(mixed_precision="bf16")
+    assert str(state.mixed_precision) == "bf16"
+    assert state.mixed_precision_policy.compute_dtype == jnp.bfloat16
+    assert state.mixed_precision_policy.param_dtype == jnp.float32
+
+
+def test_plugin_env_roundtrip(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_TP_SIZE", "4")
+    plugin = ParallelismPlugin()
+    assert plugin.tp_size == 4
+
+
+def test_sharding_strategy_enum():
+    assert "full_shard" in ShardingStrategy
+    assert ShardingStrategy("no_shard") == ShardingStrategy.NO_SHARD
