@@ -6,7 +6,11 @@ Reference: wonkyoc/accelerate (HF Accelerate 0.32.0.dev0). See SURVEY.md.
 
 __version__ = "0.1.0"
 
+from .accelerator import Accelerator
+from .data_loader import DataLoader, prepare_data_loader, skip_first_batches
 from .logging import get_logger
+from .optimizer import AcceleratedOptimizer
+from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
 from .utils import (
     DataLoaderConfiguration,
@@ -20,6 +24,12 @@ from .utils import (
 )
 
 __all__ = [
+    "Accelerator",
+    "AcceleratedOptimizer",
+    "AcceleratedScheduler",
+    "DataLoader",
+    "prepare_data_loader",
+    "skip_first_batches",
     "AcceleratorState",
     "GradientState",
     "PartialState",

@@ -1,0 +1,190 @@
+"""The sharding-rules engine: how params, optimizer state and batches map
+onto the device mesh.
+
+This module is the TPU-native replacement for the reference's entire model-
+wrapping machinery — DDP wrap (reference accelerator.py:1425-1443), FSDP
+auto-wrap policies (:1444-1553, utils/dataclasses.py:1234), DeepSpeed ZeRO
+stages (utils/deepspeed.py), and Megatron TP sharding (utils/megatron_lm.py).
+Instead of wrapping modules, we compute a :class:`NamedSharding` for every
+leaf of the param/opt-state pytree and let GSPMD lower the annotations to
+reduce-scatter/all-gather/all-to-all over ICI.
+
+Two mechanisms, compounding:
+
+* **Logical-axis rules** — models annotate params with logical axis names
+  (flax ``nn.with_partitioning`` / ``nn.get_partition_spec``); rules map
+  logical names -> mesh axes (``("embed", None), ("mlp", "tp"), ...``).
+  This is how TP/SP/EP are expressed (Megatron parity).
+* **Heuristic FSDP** — for un-annotated leaves: shard the largest dimension
+  divisible by the fsdp axis size, replicate small arrays
+  (``min_weight_size`` — the analogue of FSDP's ``min_num_params``
+  auto-wrap policy). Zero model changes needed, like wrapping a model in
+  FSDP without touching its code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.constants import (
+    MESH_AXIS_DATA,
+    MESH_AXIS_FSDP,
+    MESH_AXIS_SEQUENCE,
+    MESH_AXIS_TENSOR,
+)
+from ..utils.dataclasses import ParallelismPlugin, ShardingStrategy
+from .mesh import data_axes
+
+# Default logical-axis -> mesh-axis rules, in priority order. Models using
+# flax logical axis names (t5x/maxtext convention) get TP/SP for free.
+DEFAULT_LOGICAL_RULES: tuple[tuple[str, Optional[str]], ...] = (
+    ("batch", MESH_AXIS_DATA),
+    ("vocab", MESH_AXIS_TENSOR),
+    ("embed", None),
+    ("heads", MESH_AXIS_TENSOR),
+    ("kv", None),
+    ("mlp", MESH_AXIS_TENSOR),
+    ("expert", "ep"),
+    ("length", MESH_AXIS_SEQUENCE),
+    ("norm", None),
+)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, *, seq_dim: Optional[int] = None) -> NamedSharding:
+    """Sharding for a data batch: leading dim over all data axes
+    (dp x fsdp x ep), optional sequence dim over sp (context parallelism)."""
+    axes = data_axes(mesh)
+    spec: list[Any] = [axes]
+    if seq_dim is not None:
+        while len(spec) <= seq_dim:
+            spec.append(None)
+        if mesh.shape[MESH_AXIS_SEQUENCE] > 1:
+            spec[seq_dim] = MESH_AXIS_SEQUENCE
+    return NamedSharding(mesh, P(*spec))
+
+
+def _fsdp_spec_for_leaf(
+    arr: Any, fsdp_size: int, min_weight_size: int
+) -> P:
+    """Heuristic: shard the largest divisible dim on the fsdp axis."""
+    shape = tuple(getattr(arr, "shape", ()))
+    if not shape or int(np.prod(shape)) < min_weight_size:
+        return P()
+    # largest-first, prefer later dims on ties (output features usually last
+    # and largest; sharding them turns matmul grads into reduce-scatter).
+    order = sorted(range(len(shape)), key=lambda i: (shape[i], i), reverse=True)
+    for dim in order:
+        if shape[dim] % fsdp_size == 0 and shape[dim] >= fsdp_size:
+            spec: list[Any] = [None] * len(shape)
+            spec[dim] = MESH_AXIS_FSDP
+            return P(*spec)
+    return P()
+
+
+def _merge_fsdp_into_spec(
+    spec: P, arr: Any, fsdp_size: int, min_weight_size: int
+) -> P:
+    """Add fsdp sharding to a TP-annotated spec on a free dimension (the
+    combination the reference reaches only via Megatron+DeepSpeed)."""
+    shape = tuple(getattr(arr, "shape", ()))
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    if not shape or int(np.prod(shape)) < min_weight_size:
+        return spec
+    order = sorted(range(len(shape)), key=lambda i: (shape[i], i), reverse=True)
+    for dim in order:
+        if entries[dim] is None and shape[dim] % fsdp_size == 0 and shape[dim] >= fsdp_size:
+            entries[dim] = MESH_AXIS_FSDP
+            return P(*entries)
+    return spec
+
+
+def infer_param_shardings(
+    params: Any,
+    mesh: Mesh,
+    plugin: Optional[ParallelismPlugin] = None,
+    logical_specs: Any = None,
+    rules: Optional[Sequence[tuple[str, Optional[str]]]] = None,
+) -> Any:
+    """Compute a NamedSharding pytree for ``params``.
+
+    ``logical_specs``: optional matching pytree of logical-axis
+    PartitionSpecs (from ``nn.get_partition_spec``); mapped through
+    ``rules``. Leaves without logical specs fall back to the FSDP heuristic.
+    """
+    plugin = plugin or ParallelismPlugin()
+    rule_map = dict(DEFAULT_LOGICAL_RULES)
+    if plugin.sharding_rules:
+        rule_map.update(dict(plugin.sharding_rules))
+    if rules:
+        rule_map.update(dict(rules))
+    fsdp_on = (
+        plugin.sharding_strategy
+        in (ShardingStrategy.FULL_SHARD, ShardingStrategy.HYBRID_SHARD)
+        and mesh.shape[MESH_AXIS_FSDP] > 1
+    )
+    fsdp_size = mesh.shape[MESH_AXIS_FSDP]
+
+    def _map_logical(leaf_spec: P, arr: Any) -> P:
+        entries = []
+        for name in leaf_spec:
+            if name is None:
+                entries.append(None)
+            elif isinstance(name, (list, tuple)):
+                axes = [rule_map.get(n) for n in name]
+                axes = [a for a in axes if a and mesh.shape[a] > 1]
+                entries.append(tuple(axes) if axes else None)
+            else:
+                axis = rule_map.get(name)
+                entries.append(axis if axis and mesh.shape[axis] > 1 else None)
+        spec = P(*entries)
+        if fsdp_on:
+            spec = _merge_fsdp_into_spec(spec, arr, fsdp_size, plugin.min_weight_size)
+        return spec
+
+    def _infer_one(arr: Any, lspec: Optional[P]) -> NamedSharding:
+        if lspec is not None:
+            return NamedSharding(mesh, _map_logical(lspec, arr))
+        if fsdp_on:
+            return NamedSharding(
+                mesh, _fsdp_spec_for_leaf(arr, fsdp_size, plugin.min_weight_size)
+            )
+        return NamedSharding(mesh, P())
+
+    if logical_specs is None:
+        return jax.tree.map(lambda a: _infer_one(a, None), params)
+    return jax.tree.map(
+        _infer_one, params, logical_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def shard_params(
+    params: Any,
+    shardings: Any,
+) -> Any:
+    """Place a param pytree according to a sharding pytree. Uses device_put,
+    which moves each leaf once (host->HBM or HBM->HBM reshard)."""
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, s), params, shardings
+    )
+
+
+def shardings_of(tree: Any) -> Any:
+    """The sharding pytree of an array pytree (for jit in_shardings)."""
+    return jax.tree.map(
+        lambda x: x.sharding if isinstance(x, jax.Array) else None, tree
+    )
+
+
+def constrain(tree: Any, mesh: Mesh, spec: P) -> Any:
+    """with_sharding_constraint over a pytree (inside-jit annotation)."""
+    return jax.tree.map(
+        lambda x: jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec)), tree
+    )

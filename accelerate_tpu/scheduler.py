@@ -1,0 +1,78 @@
+"""LR scheduler wrapper.
+
+Parity: reference ``src/accelerate/scheduler.py`` — ``AcceleratedScheduler``
+:25 (skip LR step when optimizer step skipped :59; multiply steps by
+num_processes unless split_batches :71-84).
+
+TPU-native shape: an optax schedule is a pure fn ``step -> lr`` already
+evaluated *inside* the compiled train step, so "stepping the scheduler" is
+bookkeeping — this wrapper keeps the reference's semantics (process scaling,
+skip-on-overflow) for raw loops and reporting, and is checkpointable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import optax
+
+from .optimizer import AcceleratedOptimizer
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        scheduler: Union[optax.Schedule, Callable[[int], float]],
+        optimizers: Union[AcceleratedOptimizer, list[AcceleratedOptimizer], None] = None,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        self.scheduler = scheduler
+        self.optimizers = (
+            optimizers
+            if isinstance(optimizers, (list, tuple))
+            else ([optimizers] if optimizers is not None else [])
+        )
+        self.step_with_optimizer = step_with_optimizer
+        self.split_batches = split_batches
+        self.gradient_state = GradientState()
+        self._step_count = 0
+
+    @property
+    def step_count(self) -> int:
+        return self._step_count
+
+    def step(self, *args, **kwargs) -> None:
+        if not self.step_with_optimizer:
+            self._advance(1)
+            return
+        if not self.gradient_state.sync_gradients:
+            return  # accumulating: scheduler frozen
+        # skip when any optimizer skipped (fp16 overflow) — reference :59-66
+        for opt in self.optimizers:
+            if getattr(opt, "step_was_skipped", False):
+                return
+        if self.split_batches:
+            self._advance(1)
+        else:
+            # one scheduler step per process per step: LR schedules written
+            # for single-process loops stay correct under DP (reference
+            # :71-84)
+            num_processes = AcceleratorState().num_processes
+            self._advance(num_processes)
+
+    def _advance(self, n: int) -> None:
+        self._step_count += n
+
+    def get_last_lr(self) -> list[float]:
+        return [float(self.scheduler(max(0, self._step_count - 1)))]
+
+    def get_lr(self) -> list[float]:
+        return [float(self.scheduler(self._step_count))]
+
+    def state_dict(self) -> dict:
+        return {"step_count": self._step_count}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._step_count = int(state["step_count"])

@@ -1,0 +1,119 @@
+"""Optimizer/scheduler wrapper tests (reference tests/test_optimizer.py +
+tests/test_scheduler.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import AcceleratorState, GradientAccumulationPlugin, GradientState
+from accelerate_tpu.optimizer import (
+    AcceleratedOptimizer,
+    LossScaleState,
+    init_loss_scale,
+    scale_loss,
+    unscale_and_check,
+)
+from accelerate_tpu.scheduler import AcceleratedScheduler
+from accelerate_tpu.utils.dataclasses import MixedPrecisionPolicy
+
+
+def test_optimizer_rejects_non_optax():
+    AcceleratorState()
+    with pytest.raises(TypeError):
+        AcceleratedOptimizer(object())
+
+
+def test_optimizer_step_and_state():
+    AcceleratorState()
+    params = {"w": jnp.ones((4,)), "b": jnp.zeros(())}
+    opt = AcceleratedOptimizer(optax.sgd(0.1))
+    grads = {"w": jnp.ones((4,)), "b": jnp.ones(())}
+    new_params = opt.step(params, grads)
+    np.testing.assert_allclose(np.asarray(new_params["w"]), 0.9, rtol=1e-6)
+    assert not opt.step_was_skipped
+    assert opt.state_dict() is opt.opt_state
+
+
+def test_optimizer_skips_while_accumulating():
+    AcceleratorState()
+    gs = GradientState(GradientAccumulationPlugin(num_steps=2))
+    gs.sync_gradients = False
+    params = {"w": jnp.ones((4,))}
+    opt = AcceleratedOptimizer(optax.sgd(0.1))
+    out = opt.step(params, {"w": jnp.ones((4,))})
+    assert opt.step_was_skipped
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+
+def test_loss_scale_roundtrip():
+    policy = MixedPrecisionPolicy.from_precision("fp16")
+    ls = init_loss_scale(policy)
+    loss = jnp.asarray(2.0)
+    scaled = scale_loss(loss, ls)
+    assert float(scaled) == 2.0 * policy.loss_scale_init
+    grads = {"w": jnp.full((2,), float(ls.scale))}
+    unscaled, finite, new_ls = unscale_and_check(grads, ls, policy)
+    assert bool(finite)
+    np.testing.assert_allclose(np.asarray(unscaled["w"]), 1.0)
+    assert int(new_ls.fin_steps) == 1
+
+
+def test_loss_scale_overflow_halves():
+    policy = MixedPrecisionPolicy.from_precision("fp16")
+    ls = init_loss_scale(policy)
+    grads = {"w": jnp.asarray([jnp.inf, 1.0])}
+    _, finite, new_ls = unscale_and_check(grads, ls, policy)
+    assert not bool(finite)
+    assert float(new_ls.scale) == policy.loss_scale_init / 2
+    assert int(new_ls.growth_count) == 0
+
+
+def test_loss_scale_growth():
+    policy = MixedPrecisionPolicy.from_precision("fp16")
+    policy.loss_scale_growth_interval = 2
+    ls = init_loss_scale(policy)
+    grads = {"w": jnp.ones(2)}
+    for _ in range(2):
+        _, _, ls = unscale_and_check(grads, ls, policy)
+    assert float(ls.scale) == policy.loss_scale_init * 2
+
+
+def test_scheduler_steps_with_num_processes():
+    AcceleratorState()
+    sched = AcceleratedScheduler(optax.linear_schedule(1.0, 0.0, 100))
+    sched.step()
+    assert sched.step_count == 1  # single process
+    assert sched.get_last_lr()[0] == pytest.approx(1.0)
+
+
+def test_scheduler_frozen_while_accumulating():
+    AcceleratorState()
+    gs = GradientState()
+    gs.sync_gradients = False
+    sched = AcceleratedScheduler(optax.constant_schedule(0.5))
+    sched.step()
+    assert sched.step_count == 0
+    gs.sync_gradients = True
+    sched.step()
+    assert sched.step_count == 1
+
+
+def test_scheduler_skips_on_optimizer_skip():
+    AcceleratorState()
+    opt = AcceleratedOptimizer(optax.sgd(0.1))
+    opt._step_was_skipped = True
+    sched = AcceleratedScheduler(optax.constant_schedule(0.5), optimizers=opt)
+    sched.step()
+    assert sched.step_count == 0
+
+
+def test_scheduler_state_dict():
+    AcceleratorState()
+    sched = AcceleratedScheduler(optax.constant_schedule(0.5))
+    sched.step()
+    state = sched.state_dict()
+    sched2 = AcceleratedScheduler(optax.constant_schedule(0.5))
+    sched2.load_state_dict(state)
+    assert sched2.step_count == 1
