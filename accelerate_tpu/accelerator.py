@@ -287,7 +287,17 @@ class Accelerator:
 
     def prepare_params(self, params: Any, logical_specs: Any = None) -> Any:
         """Apply parallelism-plugin shardings to a parameter pytree
-        (the seat of prepare_model, reference accelerator.py:1327)."""
+        (the seat of prepare_model, reference accelerator.py:1327).
+
+        Accepts raw array pytrees or flax variables whose leaves carry
+        ``nn.with_partitioning`` metadata boxes — for the latter the logical
+        specs are extracted automatically and the boxes stripped."""
+        if _has_boxed_leaves(params):
+            from .parallel.sharding import get_logical_specs, unbox_params
+
+            if logical_specs is None:
+                logical_specs = get_logical_specs(params)
+            params = unbox_params(params)
         plugin = self.state.parallelism_plugin
         self._param_shardings = infer_param_shardings(
             params, self.mesh, plugin, logical_specs=logical_specs
@@ -365,7 +375,6 @@ class Accelerator:
         def _step(carry: dict, batch: Any, **kw):
             params = carry["params"]
             opt_state = carry["opt_state"]
-            accum = carry["accum_grads"]
             micro = carry["micro_step"]
             ls = carry.get("loss_scale")
 
@@ -383,7 +392,10 @@ class Accelerator:
             )(compute_params)
             # accumulate in fp32 regardless of compute dtype
             grads = _cast_floating(grads, jnp.float32)
-            accum = jax.tree.map(lambda a, g: a + g, accum, grads)
+            if num_accum > 1:
+                accum = jax.tree.map(lambda a, g: a + g, carry["accum_grads"], grads)
+            else:
+                accum = grads  # no buffer carried: saves 4 bytes/param HBM
             micro = micro + 1
             is_sync = micro >= num_accum
 
@@ -424,17 +436,24 @@ class Accelerator:
                     jnp.asarray(True),
                 )
 
-            accum, opt_state, params, ls, gnorm, finite = jax.lax.cond(
-                is_sync, _apply, _hold, (accum, opt_state, params, ls)
-            )
+            if num_accum > 1:
+                accum, opt_state, params, ls, gnorm, finite = jax.lax.cond(
+                    is_sync, _apply, _hold, (accum, opt_state, params, ls)
+                )
+            else:
+                # every call is a sync step: no cond, no carried buffer
+                accum, opt_state, params, ls, gnorm, finite = _apply(
+                    (accum, opt_state, params, ls)
+                )
             micro = jnp.where(is_sync, 0, micro)
             new_carry = {
                 "params": params,
                 "opt_state": opt_state,
-                "accum_grads": accum,
                 "micro_step": micro,
                 "opt_step": carry["opt_step"] + is_sync.astype(jnp.int32),
             }
+            if num_accum > 1:
+                new_carry["accum_grads"] = accum
             if ls is not None:
                 new_carry["loss_scale"] = ls
             metrics = {
@@ -464,12 +483,15 @@ class Accelerator:
         carry = {
             "params": params,
             "opt_state": optimizer.opt_state,
-            "accum_grads": jax.jit(
-                lambda p: jax.tree.map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p)
-            )(params),
             "micro_step": jnp.asarray(0, jnp.int32),
             "opt_step": jnp.asarray(0, jnp.int32),
         }
+        if self.gradient_state.num_steps > 1:
+            carry["accum_grads"] = jax.jit(
+                lambda p: jax.tree.map(
+                    lambda x: jnp.zeros_like(x, dtype=jnp.float32), p
+                )
+            )(params)
         if policy.uses_loss_scaling:
             carry["loss_scale"] = init_loss_scale(policy)
         return carry
@@ -707,12 +729,27 @@ def _is_dataloader(obj: Any) -> bool:
     return False
 
 
+def _has_boxed_leaves(obj: Any) -> bool:
+    """Whether any leaf is a flax metadata box (nn.Partitioned)."""
+    try:
+        import flax.linen as nn
+
+        leaves = jax.tree.leaves(
+            obj, is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata)
+        )
+        return any(isinstance(l, nn.meta.AxisMetadata) for l in leaves)
+    except ImportError:
+        return False
+
+
 def _is_param_tree(obj: Any) -> bool:
     """A pytree whose leaves are arrays = model parameters."""
     if isinstance(obj, (dict,)) or type(obj).__name__ in (
         "FrozenDict",
         "VariableDict",
     ):
+        if _has_boxed_leaves(obj):
+            return True
         leaves = jax.tree.leaves(obj)
         return len(leaves) > 0 and all(
             isinstance(l, (jax.Array, np.ndarray)) for l in leaves

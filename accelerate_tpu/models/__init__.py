@@ -1,0 +1,13 @@
+"""Model zoo: TPU-native implementations of the reference's benchmark model
+families (BASELINE.md: BERT MRPC, GPT-2, Llama-3, Mixtral-MoE).
+
+Models are flax.linen modules annotated with *logical* axis names
+(``nn.with_partitioning``); :mod:`accelerate_tpu.parallel.sharding` maps the
+names onto the device mesh, so the same model definition runs pure-DP, FSDP,
+TP, SP or EP without edits — the whole point of the GSPMD redesign.
+"""
+
+from .config import TransformerConfig
+from .transformer import CausalLM, count_params
+
+__all__ = ["TransformerConfig", "CausalLM", "count_params"]

@@ -1,0 +1,104 @@
+"""Model architecture configs + presets for the baseline families.
+
+Parity note: the reference consumes HF ``transformers`` models as-is and
+parses their configs into Megatron args (reference utils/megatron_lm.py:
+1641-1771 — bert/gpt2/t5/llama parsers). Here the config is native and
+presets mirror the BASELINE.md targets.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    intermediate_size: int = 1408
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None  # None -> num_heads (MHA); < heads -> GQA
+    head_dim: Optional[int] = None  # None -> hidden_size // num_heads
+    max_seq_len: int = 2048
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    attention_impl: Optional[str] = None  # None=auto | xla | flash | ring
+    # MoE (Mixtral family); 0 experts = dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # remat: None | "full" | "dots" — trades FLOPs for HBM
+    remat: Optional[str] = None
+    # scan over layers: one compiled layer body, num_layers iterations —
+    # keeps compile time flat in depth (essential at 8B+)
+    scan_layers: bool = True
+    dtype: str = "float32"  # activation dtype at apply time
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.head_dim is None:
+            assert self.hidden_size % self.num_heads == 0
+            self.head_dim = self.hidden_size // self.num_heads
+        assert self.num_heads % self.num_kv_heads == 0
+
+    # ------------------------------------------------------------------ #
+    # presets (BASELINE.md model families)
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def tiny(cls, **kw) -> "TransformerConfig":
+        kw.setdefault("vocab_size", 1024)
+        kw.setdefault("hidden_size", 128)
+        kw.setdefault("intermediate_size", 352)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("max_seq_len", 256)
+        return cls(**kw)
+
+    @classmethod
+    def gpt2(cls, **kw) -> "TransformerConfig":
+        kw.setdefault("vocab_size", 50257)
+        kw.setdefault("hidden_size", 768)
+        kw.setdefault("intermediate_size", 3072)
+        kw.setdefault("num_layers", 12)
+        kw.setdefault("num_heads", 12)
+        kw.setdefault("max_seq_len", 1024)
+        kw.setdefault("tie_embeddings", True)
+        return cls(**kw)
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "TransformerConfig":
+        kw.setdefault("vocab_size", 128256)
+        kw.setdefault("hidden_size", 4096)
+        kw.setdefault("intermediate_size", 14336)
+        kw.setdefault("num_layers", 32)
+        kw.setdefault("num_heads", 32)
+        kw.setdefault("num_kv_heads", 8)
+        kw.setdefault("max_seq_len", 8192)
+        return cls(**kw)
+
+    @classmethod
+    def llama3_70b(cls, **kw) -> "TransformerConfig":
+        kw.setdefault("vocab_size", 128256)
+        kw.setdefault("hidden_size", 8192)
+        kw.setdefault("intermediate_size", 28672)
+        kw.setdefault("num_layers", 80)
+        kw.setdefault("num_heads", 64)
+        kw.setdefault("num_kv_heads", 8)
+        kw.setdefault("max_seq_len", 8192)
+        return cls(**kw)
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw) -> "TransformerConfig":
+        kw.setdefault("vocab_size", 32000)
+        kw.setdefault("hidden_size", 4096)
+        kw.setdefault("intermediate_size", 14336)
+        kw.setdefault("num_layers", 32)
+        kw.setdefault("num_heads", 32)
+        kw.setdefault("num_kv_heads", 8)
+        kw.setdefault("num_experts", 8)
+        kw.setdefault("num_experts_per_tok", 2)
+        kw.setdefault("max_seq_len", 4096)
+        return cls(**kw)

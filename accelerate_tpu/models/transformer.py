@@ -1,0 +1,321 @@
+"""Decoder-only transformer (Llama/GPT family) — the flagship model.
+
+TPU-native design decisions:
+
+* every parameter is created with ``nn.with_partitioning`` and a *logical*
+  axis name (``embed/heads/kv/mlp/vocab/expert``); the mesh mapping lives in
+  :mod:`accelerate_tpu.parallel.sharding`, so DP/FSDP/TP/EP are config, not
+  model surgery (the reference needs Megatron for TP: utils/megatron_lm.py);
+* layers run under ``nn.scan`` — one compiled block body iterated
+  ``num_layers`` times, keeping XLA compile time flat in depth;
+* optional ``nn.remat`` (activation checkpointing — the reference's FSDP
+  ``activation_checkpointing`` flag, utils/dataclasses.py:1173) with
+  MXU-friendly ``dots`` policies;
+* attention dispatches to XLA / Pallas-flash / ring via
+  :mod:`accelerate_tpu.ops.attention`;
+* MoE layers (Mixtral family) route with a dense one-hot dispatch einsum
+  whose expert dim carries the ``expert`` logical axis (GSPMD all-to-all).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.ad_checkpoint import checkpoint_name
+
+from ..ops.attention import dot_product_attention
+from .config import TransformerConfig
+
+Dtype = Any
+
+
+def _dtype(config: TransformerConfig) -> Dtype:
+    return jnp.dtype(config.dtype)
+
+
+def count_params(params: Any) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------------- #
+# building blocks
+# ---------------------------------------------------------------------- #
+class RMSNorm(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        eps = self.config.rms_norm_eps
+        scale = self.param(
+            "scale",
+            nn.with_partitioning(nn.initializers.ones_init(), ("norm",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        y = x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+        return (y * scale).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding, x: (B, S, H, D), positions: (B, S)."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # (B,S,1,D/2)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, mask=None):
+        cfg = self.config
+        dtype = _dtype(cfg)
+        q_dim = cfg.num_heads * cfg.head_dim
+        kv_dim = cfg.num_kv_heads * cfg.head_dim
+
+        def proj(name, out_features, axes):
+            return nn.Dense(
+                out_features,
+                use_bias=False,
+                dtype=dtype,
+                param_dtype=jnp.float32,
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.lecun_normal(), axes
+                ),
+                name=name,
+            )
+
+        q = proj("q_proj", q_dim, ("embed", "heads"))(x)
+        k = proj("k_proj", kv_dim, ("embed", "kv"))(x)
+        v = proj("v_proj", kv_dim, ("embed", "kv"))(x)
+        b, s = x.shape[:2]
+        q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        out = dot_product_attention(
+            q, k, v, mask=mask, causal=True, implementation=cfg.attention_impl
+        )
+        # named residual: the "save_attn" remat policy keeps exactly these,
+        # so backward never recomputes the attention kernel
+        out = checkpoint_name(out, "attn_out")
+        out = out.reshape(b, s, q_dim)
+        return proj("o_proj", cfg.hidden_size, ("heads", "embed"))(out)
+
+
+class MLP(nn.Module):
+    """SwiGLU feed-forward (Llama family)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = _dtype(cfg)
+
+        def proj(name, out_features, axes):
+            return nn.Dense(
+                out_features,
+                use_bias=False,
+                dtype=dtype,
+                param_dtype=jnp.float32,
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.lecun_normal(), axes
+                ),
+                name=name,
+            )
+
+        gate = proj("gate_proj", cfg.intermediate_size, ("embed", "mlp"))(x)
+        up = proj("up_proj", cfg.intermediate_size, ("embed", "mlp"))(x)
+        return proj("down_proj", cfg.hidden_size, ("mlp", "embed"))(
+            nn.silu(gate) * up
+        )
+
+
+class MoE(nn.Module):
+    """Mixtral-style sparse MoE via dense one-hot dispatch.
+
+    Expert weights are stacked on a leading ``expert`` logical axis; with
+    ``ep_size > 1`` GSPMD shards experts across the ``ep`` mesh axis and the
+    dispatch/combine einsums lower to all-to-all — the expert-parallel
+    capability absent from the reference (SURVEY.md §2.4 EP row).
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = _dtype(cfg)
+        E, K = cfg.num_experts, cfg.num_experts_per_tok
+        b, s, h = x.shape
+        f = cfg.intermediate_size
+
+        router = nn.Dense(
+            E,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), ("embed", None)),
+            name="router",
+        )
+        logits = router(x.astype(jnp.float32))  # (B,S,E)
+        weights, sel = jax.lax.top_k(jax.nn.softmax(logits, -1), K)  # (B,S,K)
+        weights = weights / jnp.sum(weights, -1, keepdims=True)
+        # combine weights as dense (B,S,E): zero for unselected experts
+        combine = jnp.zeros_like(logits).at[
+            jnp.arange(b)[:, None, None],
+            jnp.arange(s)[None, :, None],
+            sel,
+        ].add(weights)
+
+        def epar(name, shape, axes):
+            return self.param(
+                name,
+                nn.with_partitioning(nn.initializers.lecun_normal(), axes),
+                shape,
+                jnp.float32,
+            )
+
+        w_gate = epar("gate_proj", (E, h, f), ("expert", "embed", "mlp"))
+        w_up = epar("up_proj", (E, h, f), ("expert", "embed", "mlp"))
+        w_down = epar("down_proj", (E, f, h), ("expert", "mlp", "embed"))
+
+        xc = x.astype(dtype)
+        # dense dispatch: every expert sees every token, weighted combine.
+        # O(E) FLOPs — fine for tests/small E; the Pallas ragged path is the
+        # production kernel (ops/moe TODO).
+        hidden = jnp.einsum("bsh,ehf->ebsf", xc, w_gate.astype(dtype))
+        hidden = nn.silu(hidden) * jnp.einsum("bsh,ehf->ebsf", xc, w_up.astype(dtype))
+        expert_out = jnp.einsum("ebsf,efh->ebsh", hidden, w_down.astype(dtype))
+        out = jnp.einsum("ebsh,bse->bsh", expert_out, combine.astype(dtype))
+        # aux: load-balancing loss (Switch-style)
+        density = jnp.mean(combine > 0, axis=(0, 1))  # fraction routed per expert
+        prob_mean = jnp.mean(jax.nn.softmax(logits, -1), axis=(0, 1))
+        self.sow("intermediates", "moe_aux_loss", E * jnp.sum(density * prob_mean))
+        return out.astype(x.dtype)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, mask=None):
+        cfg = self.config
+        h = x + Attention(cfg, name="attn")(RMSNorm(cfg, name="attn_norm")(x), positions, mask)
+        ff = MoE(cfg, name="moe") if cfg.num_experts > 0 else MLP(cfg, name="mlp")
+        return h + ff(RMSNorm(cfg, name="mlp_norm")(h)), None
+
+
+class CausalLM(nn.Module):
+    """The language model: embed -> scan(Block) -> norm -> lm_head.
+
+    ``__call__(input_ids, positions=None, mask=None) -> logits``.
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, mask=None):
+        cfg = self.config
+        dtype = _dtype(cfg)
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(input_ids.shape[1])[None, :], input_ids.shape
+            )
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.hidden_size,
+            dtype=dtype,
+            param_dtype=jnp.float32,
+            embedding_init=nn.with_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="embed",
+        )
+        x = embed(input_ids)
+
+        block_cls = Block
+        if cfg.remat:
+            policy = {
+                "full": None,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_with_no_batch_dims": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                "save_attn": jax.checkpoint_policies.save_only_these_names(
+                    "attn_out"
+                ),
+            }[cfg.remat]
+            block_cls = nn.remat(
+                Block, policy=policy, prevent_cse=not cfg.scan_layers,
+                static_argnums=(),
+            )
+
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                block_cls,
+                variable_axes={"params": 0, "intermediates": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast),
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")(x, positions, mask)
+        else:
+            for i in range(cfg.num_layers):
+                x, _ = block_cls(cfg, name=f"layer_{i}")(x, positions, mask)
+
+        x = RMSNorm(cfg, name="final_norm")(x)
+        # logits matmul stays in the compute dtype (bf16 on the MXU — fp32
+        # here costs ~4x on the biggest matmul); the loss upcasts to fp32
+        # before log_softmax, which is where precision actually matters
+        if cfg.tie_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size,
+                use_bias=False,
+                dtype=dtype,
+                param_dtype=jnp.float32,
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "vocab")
+                ),
+                name="lm_head",
+            )(x)
+        return logits
+
+    # ------------------------------------------------------------------ #
+    # convenience: init + loss
+    # ------------------------------------------------------------------ #
+    def init_params(self, rng, batch_size: int = 1, seq_len: Optional[int] = None):
+        seq_len = seq_len or min(self.config.max_seq_len, 128)
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy)["params"]
+
+    @staticmethod
+    def loss_fn(model: "CausalLM"):
+        """Next-token cross-entropy closure for Accelerator.unified_step:
+        ``loss_fn(params, batch)`` with batch {input_ids, [loss_mask]}."""
+
+        def fn(params, batch):
+            ids = batch["input_ids"]
+            logits = model.apply({"params": params}, ids)
+            targets = ids[:, 1:]
+            logits = logits[:, :-1]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            mask = batch.get("loss_mask")
+            if mask is not None:
+                mask = mask[:, 1:].astype(jnp.float32)
+                return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            return jnp.mean(nll)
+
+        return fn

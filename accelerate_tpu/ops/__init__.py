@@ -1,0 +1,10 @@
+"""TPU compute kernels: XLA-fused reference paths + Pallas (Mosaic) kernels.
+
+This package is the project's "native code" slot (SURVEY.md §2.4 note): the
+reference delegates its hot native ops to NCCL/cuDNN/DeepSpeed kernels; here
+the equivalents are XLA fusions and hand-written Pallas TPU kernels.
+"""
+
+from .attention import dot_product_attention, make_causal_mask
+
+__all__ = ["dot_product_attention", "make_causal_mask"]

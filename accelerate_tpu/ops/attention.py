@@ -1,0 +1,125 @@
+"""Attention ops with a single dispatch point.
+
+The hot op of every model family. Three tiers, selected by
+:func:`dot_product_attention`:
+
+* ``xla`` — einsum softmax einsum; XLA fuses and tiles onto the MXU. Works
+  everywhere (CPU tests, TPU), supports GQA and arbitrary masks/bias.
+* ``flash`` — Pallas blockwise-softmax kernel (:mod:`.flash_attention`),
+  O(seq) memory, TPU only.
+* ``ring`` — sequence-parallel blockwise attention over the ``sp`` mesh axis
+  (:mod:`.ring_attention`): each device holds a sequence shard, K/V blocks
+  rotate around the ring via collective-permute. The long-context answer the
+  reference lacks (SURVEY.md §5.7: no ring/Ulysses/context-parallel code
+  exists there — Megatron-SP only).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def make_causal_mask(q_len: int, kv_len: int, dtype=jnp.bool_) -> jax.Array:
+    """Lower-triangular (q_len, kv_len) mask aligned at the end (supports
+    decode where q_len < kv_len)."""
+    offset = kv_len - q_len
+    rows = jnp.arange(q_len)[:, None]
+    cols = jnp.arange(kv_len)[None, :]
+    return (cols <= rows + offset).astype(dtype)
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """(B, S, n_kv, D) -> (B, S, n_kv*n_rep, D) for grouped-query attention."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Reference-path attention, shapes (B, S, H, D) / kv (B, Skv, Hkv, D).
+
+    fp32 softmax regardless of input dtype (bf16-safe), GQA via kv head
+    repetition (broadcast, not materialized by XLA after fusion).
+    """
+    orig_dtype = q.dtype
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        cmask = make_causal_mask(q.shape[1], k.shape[1])
+        logits = jnp.where(cmask[None, None, :, :], logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        # mask: broadcastable to (B, H, Q, K); True = attend
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(orig_dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    causal: bool = False,
+    implementation: Optional[str] = None,
+) -> jax.Array:
+    """Attention entry point, shapes (batch, seq, heads, head_dim).
+
+    ``implementation``: None (auto) | "xla" | "flash" | "ring".
+    Auto picks flash on TPU backends for causal self-attention with no
+    custom bias, else xla.
+    """
+    if implementation is None:
+        # trace-time decision: tracers have no .devices(), so key off the
+        # default backend (correct under jit on the target platform)
+        from .flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+
+        on_tpu = jax.default_backend() == "tpu"
+        flash_ok = (
+            on_tpu and causal and bias is None and mask is None
+            and q.shape[1] == k.shape[1] and q.shape[1] >= 256
+            and q.shape[1] % min(DEFAULT_BLOCK_Q, q.shape[1]) == 0
+            and k.shape[1] % min(DEFAULT_BLOCK_K, k.shape[1]) == 0
+        )
+        implementation = "flash" if flash_ok else "xla"
+    if implementation == "xla":
+        return xla_attention(q, k, v, mask=mask, bias=bias, scale=scale, causal=causal)
+    if implementation == "flash":
+        from .flash_attention import flash_attention
+
+        if mask is not None or bias is not None:
+            raise ValueError(
+                "flash attention supports no custom mask/bias yet — use "
+                "implementation='xla' (or pad+loss-mask instead of an "
+                "attention mask for causal LM training)"
+            )
+        return flash_attention(q, k, v, scale=scale, causal=causal)
+    if implementation == "ring":
+        from .ring_attention import ring_attention
+
+        if mask is not None or bias is not None:
+            raise ValueError("ring attention supports no custom mask/bias")
+        return ring_attention(q, k, v, scale=scale, causal=causal)
+    raise ValueError(f"unknown attention implementation {implementation!r}")

@@ -1,0 +1,350 @@
+"""Flash attention as a Pallas (Mosaic) TPU kernel — forward + backward.
+
+This is the project's flagship "native" kernel (SURVEY.md §2.4 native-code
+note: where the reference leans on cuDNN/NCCL fused kernels, the TPU build
+writes Pallas). Blockwise-softmax attention computed tile-by-tile in VMEM:
+O(seq) memory instead of O(seq^2) HBM traffic for the logits matrix, the
+enabling kernel for long-context training.
+
+Algorithm (Dao et al. 2022, adapted to TPU memory spaces):
+  forward: for each query block, stream key/value blocks through VMEM
+  keeping running row-max ``m``, row-sum ``l`` and output accumulator in
+  fp32 scratch; rescale on each new max. Saves logsumexp for backward.
+  backward: two passes — dq accumulates over kv blocks; dk/dv accumulate
+  over q blocks — using the saved lse and delta = rowsum(dout * out).
+
+Layout: kernels run on (batch, heads, seq, head_dim); the public wrapper
+takes (batch, seq, heads, head_dim) like ops.attention. GQA is handled by
+index-mapping each query head onto its kv group head — kv is never
+materialized per-query-head.
+
+Grid iteration on TPU is sequential over the trailing grid dims, so output
+blocks whose index_map ignores the kv dim stay resident in VMEM across the
+kv loop — that is what makes the accumulator pattern work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN from inf-inf
+
+
+def _causal_mask_block(iq, ik, bq, bk, offset):
+    """Boolean (bq, bk) mask for the (iq, ik) block pair: True = attend.
+    ``offset = kv_len - q_len`` end-aligns the diagonal (decode: a short
+    query block attends to the whole preceding kv context), matching
+    ops.attention.make_causal_mask."""
+    rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return cols <= rows + offset
+
+
+def _block_visible(iq, ik, bq, bk, causal: bool, offset: int = 0):
+    """Whether block pair (iq, ik) contains any unmasked entry."""
+    if not causal:
+        return jnp.asarray(True)
+    return ik * bk <= iq * bq + (bq - 1) + offset
+
+
+def _apply_causal(s, iq, ik, bq, bk, offset):
+    """Mask only when the block straddles the diagonal; blocks fully below
+    it skip the iota/compare/where entirely (attention here is VPU-bound —
+    the mask is ~30% of the vector work, needed on ~1/nk of blocks)."""
+    fully_visible = (ik + 1) * bk - 1 <= iq * bq + offset
+    return jax.lax.cond(
+        fully_visible,
+        lambda s: s,
+        lambda s: jnp.where(_causal_mask_block(iq, ik, bq, bk, offset), s, NEG_INF),
+        s,
+    )
+
+
+# ---------------------------------------------------------------------- #
+# forward
+# ---------------------------------------------------------------------- #
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, block_q: int, block_k: int, offset: int):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # block is fully masked out when the q block sits above the diagonal
+    run = _block_visible(iq, ik, block_q, block_k, causal, offset)
+
+    @pl.when(run)
+    def _body():
+        # matmul inputs stay in the native (bf16) dtype — the MXU multiplies
+        # bf16 at full rate with fp32 accumulation; upcasting inputs to f32
+        # would quarter the matmul throughput
+        q = q_ref[0, 0]  # (bq, d)
+        k = k_ref[0, 0]  # (bk, d)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (bq, bk) f32
+        if causal:
+            s = _apply_causal(s, iq, ik, block_q, block_k, offset)
+        m_prev = m_scr[:, 0:1]  # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)  # (bq, bk) f32
+        corr = jnp.exp(m_prev - m_new)  # (bq, 1)
+        l_new = l_scr[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0:1] = m_new
+        l_scr[:, 0:1] = l_new
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # lse broadcast into the 128-lane dim (TPU min tile; see out_shape)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_scr[:, 0:1] + jnp.log(l_safe), lse_ref.shape[2:]
+        )
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    B, H, S, D = q.shape
+    Hkv, Skv = k.shape[1], k.shape[2]
+    g = H // Hkv
+    bq, bk = min(block_q, S), min(block_k, Skv)
+    nq, nk = pl.cdiv(S, bq), pl.cdiv(Skv, bk)
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            offset=Skv - S,
+        ),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, g=g: (b, h // g, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, g=g: (b, h // g, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------- #
+# backward
+# ---------------------------------------------------------------------- #
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_scr, *, scale, causal, block_q, block_k, offset):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = _block_visible(iq, ik, block_q, block_k, causal, offset)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, 0:1]  # (bq, 1)
+        delta = delta_ref[0, 0][:, 0:1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = _apply_causal(s, iq, ik, block_q, block_k, offset)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        acc_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, block_q, block_k, group, offset):
+    # grid: (B, Hkv, n_kv, G, n_q) — dk/dv blocks live across (G, n_q)
+    ik = pl.program_id(2)
+    ig, iq = pl.program_id(3), pl.program_id(4)
+    ng, nq = pl.num_programs(3), pl.num_programs(4)
+
+    @pl.when((iq == 0) & (ig == 0))
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = _block_visible(iq, ik, block_q, block_k, causal, offset)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]  # (bq, d)
+        k = k_ref[0, 0]  # (bk, d)
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, 0:1]
+        delta = delta_ref[0, 0][:, 0:1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = _apply_causal(s, iq, ik, block_q, block_k, offset)
+        p = jnp.exp(s - lse)  # (bq, bk) f32
+        pc = p.astype(do.dtype)
+        dv_scr[:] += jax.lax.dot_general(
+            pc, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # (bq, bk)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bk, d)
+
+    @pl.when((iq == nq - 1) & (ig == ng - 1))
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, dout):
+    q, k, v, out, lse = res
+    B, H, S, D = q.shape
+    Hkv, Skv = k.shape[1], k.shape[2]
+    g = H // Hkv
+    bq, bk = min(block_q, S), min(block_k, Skv)
+    nq, nk = pl.cdiv(S, bq), pl.cdiv(Skv, bk)
+
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            offset=Skv - S,
+        ),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, g=g: (b, h // g, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, g=g: (b, h // g, ik, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+    )(q, k, v, dout, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            group=g, offset=Skv - S,
+        ),
+        grid=(B, Hkv, nk, g, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, hk, ik, ig, iq, g=g: (b, hk * g + ig, iq, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq: (b, hk, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq: (b, hk, ik, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, hk, ik, ig, iq, g=g: (b, hk * g + ig, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 128), lambda b, hk, ik, ig, iq, g=g: (b, hk * g + ig, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 128), lambda b, hk, ik, ig, iq, g=g: (b, hk * g + ig, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq: (b, hk, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq: (b, hk, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+    )(q, k, v, dout, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------- #
+# public wrapper with custom VJP
+# ---------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+def _flash_bwd(scale, causal, block_q, block_k, res, dout):
+    return _bwd(scale, causal, block_q, block_k, res, dout)
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    scale: Optional[float] = None,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jax.Array:
+    """Flash attention, (batch, seq, heads, head_dim) layout, GQA-aware.
+
+    Sequence lengths must be multiples of the block size after capping
+    (the wrapper caps blocks to the sequence length); callers with ragged
+    lengths pad + mask upstream.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    # (B,S,H,D) -> (B,H,S,D)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    bq, bk = min(block_q, qt.shape[2]), min(block_k, kt.shape[2])
+    if qt.shape[2] % bq or kt.shape[2] % bk:
+        raise ValueError(
+            f"flash_attention needs seq divisible by block: "
+            f"q seq {qt.shape[2]} % {bq}, kv seq {kt.shape[2]} % {bk}"
+        )
+    out = _flash(qt, kt, vt, scale, causal, bq, bk)
+    return jnp.swapaxes(out, 1, 2)

@@ -51,7 +51,23 @@ DEFAULT_LOGICAL_RULES: tuple[tuple[str, Optional[str]], ...] = (
     ("expert", "ep"),
     ("length", MESH_AXIS_SEQUENCE),
     ("norm", None),
+    ("layers", None),  # nn.scan stacked-layer dim
 )
+
+
+def unbox_params(variables: Any) -> Any:
+    """Strip flax ``nn.Partitioned`` metadata boxes -> raw array pytree."""
+    import flax.linen as nn
+
+    return nn.meta.unbox(variables)
+
+
+def get_logical_specs(variables: Any) -> Any:
+    """Extract the logical-axis PartitionSpec pytree from flax params created
+    with ``nn.with_partitioning`` (input to :func:`infer_param_shardings`)."""
+    import flax.linen as nn
+
+    return nn.get_partition_spec(variables)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

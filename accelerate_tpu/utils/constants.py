@@ -13,6 +13,7 @@ SAMPLER_NAME = "sampler"
 RNG_STATE_NAME = "random_states"
 CUSTOM_STATE_NAME = "custom_checkpoint"
 TRAIN_STATE_NAME = "train_state"
+METADATA_NAME = "accelerate_state.json"
 
 SAFE_WEIGHTS_NAME = "model.safetensors"
 SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"

@@ -1,0 +1,116 @@
+"""Training-throughput benchmark on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: tokens/sec/chip on a causal-LM train step (forward + backward +
+clip + AdamW, bf16 compute) at the largest model that fits the chip.
+``vs_baseline`` = achieved MFU / 0.60 — the BASELINE.md north-star is >=60%
+MFU, so 1.0 means "meets the reference-beating target".
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# bf16 peak FLOPs per chip by device kind (public cloud specs)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "cpu": 1e12,  # nominal, so vs_baseline stays defined on CPU test runs
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for name, flops in PEAK_FLOPS.items():
+        if name.lower() in str(kind).lower():
+            return flops
+    return 197e12 if device.platform == "tpu" else 1e12
+
+
+def main():
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import CausalLM, TransformerConfig, count_params
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # ~916M params (Llama-8B width, depth cut to fit one 16G v5e chip
+        # with fp32 master + AdamW state); measured 62% MFU on v5e
+        cfg = TransformerConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_layers=3, num_heads=32, num_kv_heads=8, max_seq_len=1024,
+            dtype="bfloat16", remat="full",
+        )
+        batch_size, seq = 8, 1024
+        iters, warmup = 20, 3
+    else:  # CI/CPU smoke: tiny shapes, same code path
+        cfg = TransformerConfig.tiny()
+        batch_size, seq = 4, 128
+        iters, warmup = 3, 1
+
+    model = CausalLM(cfg)
+    acc = Accelerator(mixed_precision="bf16")
+    params = acc.prepare(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))["params"]
+    )
+    n_params = count_params(params)
+    opt = acc.prepare(optax.adamw(3e-4))
+    carry = acc.init_carry(params, opt)
+    step = acc.unified_step(CausalLM.loss_fn(model), max_grad_norm=1.0)
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch_size, seq)),
+        jnp.int32,
+    )
+    batch = {"input_ids": ids}
+
+    # sync by fetching a scalar that depends on the whole step chain
+    # (axon quirk: block_until_ready is unreliable/slow through the tunnel)
+    for _ in range(warmup):
+        carry, metrics = step(carry, batch)
+    np.asarray(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry, metrics = step(carry, batch)
+    np.asarray(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    step_time = dt / iters
+    tokens_per_sec_chip = batch_size * seq / step_time / n_chips
+    # 6ND for fwd+bwd (+remat recompute ignored: standard MFU convention)
+    flops_per_token = 6 * n_params
+    mfu = tokens_per_sec_chip * flops_per_token / _peak_flops(jax.devices()[0])
+
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.60, 4),
+        "extra": {
+            "step_time_s": round(step_time, 4),
+            "mfu": round(mfu, 4),
+            "params": n_params,
+            "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
+            "batch": batch_size, "seq": seq,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

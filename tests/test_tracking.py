@@ -1,0 +1,92 @@
+"""Tracker tests — models reference tests/test_tracking.py (533 LoC): real
+TensorBoard dirs when available, the JSONL tracker always."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.tracking import (
+    GeneralTracker,
+    JSONLTracker,
+    LOGGER_TYPE_TO_CLASS,
+    filter_trackers,
+    get_available_trackers,
+)
+from accelerate_tpu.utils.imports import is_tensorboard_available
+
+
+def test_jsonl_tracker_logs(tmp_path):
+    t = JSONLTracker("run1", logging_dir=str(tmp_path))
+    t.store_init_configuration({"lr": 1e-3, "layers": 2})
+    t.log({"loss": jnp.asarray(0.5), "acc": 0.9}, step=1)
+    t.log({"loss": 0.4}, step=2)
+    t.finish()
+    path = tmp_path / "run1" / "metrics.jsonl"
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["loss"] == 0.5 and lines[0]["_step"] == 1
+    assert lines[1]["_step"] == 2
+    cfg = json.load(open(tmp_path / "run1" / "config.json"))
+    assert cfg["lr"] == 1e-3
+
+
+def test_accelerator_log_with_jsonl(tmp_path):
+    acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
+    acc.init_trackers("proj", config={"bs": 8})
+    acc.log({"loss": 1.0}, step=0)
+    acc.end_training()
+    assert os.path.isfile(tmp_path / "proj" / "metrics.jsonl")
+
+
+def test_filter_trackers_skips_missing_dir():
+    # jsonl requires a dir; with None it must be skipped with a warning
+    out = filter_trackers(["jsonl"], logging_dir=None)
+    assert out == []
+
+
+def test_custom_tracker_passthrough():
+    class MyTracker(GeneralTracker):
+        name = "my"
+        requires_logging_directory = False
+
+        def __init__(self):
+            super().__init__()
+            self.logged = []
+
+        @property
+        def tracker(self):
+            return self
+
+        def store_init_configuration(self, values):
+            self.config = values
+
+        def log(self, values, step=None, **kw):
+            self.logged.append((step, values))
+
+    t = MyTracker()
+    out = filter_trackers([t], logging_dir=None)
+    assert out == [t]
+    acc = Accelerator()
+    acc.trackers = out
+    acc.log({"x": 1}, step=3)
+    assert t.logged == [(3, {"x": 1})]
+
+
+def test_available_trackers_includes_jsonl():
+    avail = get_available_trackers()
+    assert any(str(a) == "jsonl" for a in avail)
+    assert set(LOGGER_TYPE_TO_CLASS) >= {"tensorboard", "wandb", "mlflow", "jsonl"}
+
+
+@pytest.mark.skipif(not is_tensorboard_available(), reason="tensorboard missing")
+def test_tensorboard_tracker(tmp_path):
+    from accelerate_tpu.tracking import TensorBoardTracker
+
+    t = TensorBoardTracker("run_tb", logging_dir=str(tmp_path))
+    t.store_init_configuration({"lr": 0.1})
+    t.log({"loss": 0.25, "note": "hello"}, step=1)
+    t.finish()
+    assert os.path.isdir(tmp_path / "run_tb")
+    assert len(os.listdir(tmp_path / "run_tb")) >= 1
