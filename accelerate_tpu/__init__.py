@@ -7,7 +7,17 @@ Reference: wonkyoc/accelerate (HF Accelerate 0.32.0.dev0). See SURVEY.md.
 __version__ = "0.1.0"
 
 from .accelerator import Accelerator
+from .big_modeling import (
+    cpu_offload,
+    disk_offload,
+    dispatch_params,
+    infer_auto_device_map,
+    init_empty_weights,
+    init_on_device,
+    load_checkpoint_and_dispatch,
+)
 from .data_loader import DataLoader, prepare_data_loader, skip_first_batches
+from .launchers import debug_launcher, notebook_launcher
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler
@@ -22,8 +32,19 @@ from .utils import (
     ShardingStrategy,
     set_seed,
 )
+from .utils.memory import find_executable_batch_size
 
 __all__ = [
+    "cpu_offload",
+    "disk_offload",
+    "dispatch_params",
+    "infer_auto_device_map",
+    "init_empty_weights",
+    "init_on_device",
+    "load_checkpoint_and_dispatch",
+    "debug_launcher",
+    "notebook_launcher",
+    "find_executable_batch_size",
     "Accelerator",
     "AcceleratedOptimizer",
     "AcceleratedScheduler",

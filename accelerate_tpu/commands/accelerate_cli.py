@@ -1,0 +1,39 @@
+"""CLI front door: `accelerate-tpu <command>` (reference
+commands/accelerate_cli.py:27 registers the subcommand zoo)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .config import config_command_parser
+from .env import env_command_parser
+from .estimate import estimate_command_parser
+from .launch import launch_command_parser
+from .merge import merge_command_parser
+from .test import test_command_parser
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu",
+        usage="accelerate-tpu <command> [<args>]",
+        allow_abbrev=False,
+    )
+    subparsers = parser.add_subparsers(dest="command")
+    config_command_parser(subparsers)
+    launch_command_parser(subparsers)
+    env_command_parser(subparsers)
+    estimate_command_parser(subparsers)
+    merge_command_parser(subparsers)
+    test_command_parser(subparsers)
+
+    args = parser.parse_args(argv)
+    if not hasattr(args, "func"):
+        parser.print_help()
+        sys.exit(1)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""`accelerate-tpu config` — questionnaire + YAML config file.
+
+Parity: reference ``commands/config/`` (~1700 LoC: interactive questionnaire
+``cluster.py:49-723``, ``ClusterConfig`` serialization ``config_args.py:244``,
+``write_basic_config`` ``default.py:29``). The TPU build's question set
+collapses to what matters here: topology (hosts/chips), the mesh degrees
+(dp/fsdp/tp/sp/ep), precision, and gradient accumulation — DeepSpeed/FSDP/
+Megatron engine pages have no equivalent because sharding replaced them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+from ..utils.constants import DEFAULT_CONFIG_DIR, ENV_PREFIX
+from ..utils.imports import is_yaml_available
+
+default_config_dir = os.path.expanduser(DEFAULT_CONFIG_DIR)
+default_yaml_config_file = os.path.join(default_config_dir, "default_config.yaml")
+default_json_config_file = os.path.join(default_config_dir, "default_config.json")
+
+
+def default_config_file() -> str:
+    if os.path.isfile(default_yaml_config_file):
+        return default_yaml_config_file
+    return default_json_config_file
+
+
+@dataclass
+class ClusterConfig:
+    """The saved launch configuration (reference config_args.py:244)."""
+
+    compute_environment: str = "LOCAL_MACHINE"
+    distributed_type: str = "TPU"
+    num_processes: int = 1  # processes (hosts), not chips
+    num_machines: int = 1
+    machine_rank: int = 0
+    main_process_ip: Optional[str] = None
+    main_process_port: Optional[int] = None
+    mixed_precision: str = "bf16"
+    gradient_accumulation_steps: int = 1
+    # mesh degrees
+    dp_size: int = -1
+    fsdp_size: int = 1
+    tp_size: int = 1
+    sp_size: int = 1
+    ep_size: int = 1
+    sharding_strategy: str = "full_shard"
+    # pod fan-out
+    tpu_name: Optional[str] = None
+    tpu_zone: Optional[str] = None
+    downcast_bf16: bool = False
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in asdict(self).items() if v is not None}
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or default_yaml_config_file
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        data = self.to_dict()
+        if path.endswith((".yaml", ".yml")) and is_yaml_available():
+            import yaml
+
+            with open(path, "w") as f:
+                yaml.safe_dump(data, f)
+        else:
+            with open(path, "w") as f:
+                json.dump(data, f, indent=2)
+        return path
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "ClusterConfig":
+        path = path or default_config_file()
+        if not os.path.isfile(path):
+            raise FileNotFoundError(
+                f"no config at {path}; run `accelerate-tpu config` first"
+            )
+        if path.endswith((".yaml", ".yml")):
+            import yaml
+
+            with open(path) as f:
+                data = yaml.safe_load(f)
+        else:
+            with open(path) as f:
+                data = json.load(f)
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in (data or {}).items() if k in known})
+
+    def to_env(self) -> dict[str, str]:
+        """The env-var transport into workers (reference launch.py env
+        builders): every mesh degree and precision flag becomes
+        ACCELERATE_TPU_*."""
+        env = {
+            ENV_PREFIX + "MIXED_PRECISION": self.mixed_precision,
+            ENV_PREFIX + "GRADIENT_ACCUMULATION_STEPS": str(
+                self.gradient_accumulation_steps
+            ),
+            ENV_PREFIX + "DP_SIZE": str(self.dp_size),
+            ENV_PREFIX + "FSDP_SIZE": str(self.fsdp_size),
+            ENV_PREFIX + "TP_SIZE": str(self.tp_size),
+            ENV_PREFIX + "SP_SIZE": str(self.sp_size),
+            ENV_PREFIX + "EP_SIZE": str(self.ep_size),
+            ENV_PREFIX + "SHARDING_STRATEGY": self.sharding_strategy,
+        }
+        if self.num_machines > 1:
+            env[ENV_PREFIX + "NUM_PROCESSES"] = str(self.num_machines)
+            if self.main_process_ip:
+                env[ENV_PREFIX + "COORDINATOR_ADDRESS"] = (
+                    f"{self.main_process_ip}:{self.main_process_port or 8476}"
+                )
+        return env
+
+
+def _ask(prompt: str, default: Any, cast=str):
+    raw = input(f"{prompt} [{default}]: ").strip()
+    return cast(raw) if raw else default
+
+
+def get_user_input() -> ClusterConfig:
+    """Interactive questionnaire (reference cluster.py:49)."""
+    print("accelerate_tpu configuration")
+    print("----------------------------")
+    cfg = ClusterConfig()
+    cfg.num_machines = _ask("How many hosts (machines)?", 1, int)
+    if cfg.num_machines > 1:
+        cfg.machine_rank = _ask("Rank of this machine?", 0, int)
+        cfg.main_process_ip = _ask("Coordinator (rank 0) IP?", "", str) or None
+        cfg.main_process_port = _ask("Coordinator port?", 8476, int)
+    cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16)?", "bf16")
+    cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps?", 1, int)
+    cfg.fsdp_size = _ask("FSDP (parameter-sharding) degree (1=off, -1=all)?", 1, int)
+    cfg.tp_size = _ask("Tensor-parallel degree?", 1, int)
+    cfg.sp_size = _ask("Sequence-parallel (ring attention) degree?", 1, int)
+    cfg.ep_size = _ask("Expert-parallel degree (MoE)?", 1, int)
+    cfg.dp_size = _ask("Data-parallel degree (-1 = remaining chips)?", -1, int)
+    return cfg
+
+
+def config_command(args) -> None:
+    cfg = get_user_input()
+    path = cfg.save(args.config_file)
+    print(f"Configuration saved at {path}")
+
+
+def write_basic_config(
+    mixed_precision: str = "bf16", save_location: Optional[str] = None
+) -> str:
+    """Non-interactive default config (reference default.py:29)."""
+    cfg = ClusterConfig(mixed_precision=mixed_precision)
+    return cfg.save(save_location)
+
+
+def config_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    if subparsers is not None:
+        parser = subparsers.add_parser("config", help="Create the launch config")
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu config")
+    parser.add_argument("--config_file", default=None, help="Where to save")
+    if subparsers is not None:
+        parser.set_defaults(func=config_command)
+    return parser

@@ -1,0 +1,54 @@
+"""`accelerate-tpu env` — bug-report environment dump (reference
+commands/env.py:47)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+
+
+def env_command(args) -> None:
+    import jax
+
+    import accelerate_tpu
+
+    info = {
+        "accelerate_tpu version": accelerate_tpu.__version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "JAX version": jax.__version__,
+        "JAX backend": jax.default_backend(),
+        "Devices": str(jax.devices()),
+        "Process count": jax.process_count(),
+    }
+    try:
+        import flax
+        import optax
+
+        info["Flax version"] = flax.__version__
+        info["Optax version"] = getattr(optax, "__version__", "?")
+    except ImportError:
+        pass
+    from .config import default_config_file
+
+    path = default_config_file()
+    info["Config file"] = path if os.path.isfile(path) else f"{path} (not found)"
+    accel_env = {
+        k: v for k, v in os.environ.items() if k.startswith("ACCELERATE_TPU_")
+    }
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    for k, v in info.items():
+        print(f"- `{k}`: {v}")
+    if accel_env:
+        print("- Environment:")
+        for k, v in sorted(accel_env.items()):
+            print(f"    - {k}={v}")
+
+
+def env_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    if subparsers is not None:
+        parser = subparsers.add_parser("env", help="Print environment info")
+        parser.set_defaults(func=env_command)
+        return parser
+    return argparse.ArgumentParser("accelerate-tpu env")

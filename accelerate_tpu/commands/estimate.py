@@ -1,0 +1,106 @@
+"""`accelerate-tpu estimate-memory` — model memory calculator.
+
+Parity: reference ``commands/estimate.py`` (309 LoC): meta-device model from
+a Hub config (``create_empty_model`` :63), training usage ≈ Adam 4x param
+bytes (``estimate_training_usage`` :215), ascii table (:139). Here the
+abstract init is ``jax.eval_shape`` (truly zero-alloc) and the training
+column reflects this framework's actual layout: fp32 master + 2 AdamW
+moments + bf16 compute cast (+ optional fp32 accum buffer).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _human(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.2f} {unit}"
+        n /= 1024
+    return f"{n:.2f} PB"
+
+
+def estimate_from_config(preset_or_json: str, dtype: str = "bfloat16",
+                         grad_accum: bool = False) -> dict:
+    from ..models import CausalLM, TransformerConfig
+
+    presets = {
+        "tiny": TransformerConfig.tiny,
+        "gpt2": TransformerConfig.gpt2,
+        "llama3-8b": TransformerConfig.llama3_8b,
+        "llama3-70b": TransformerConfig.llama3_70b,
+        "mixtral-8x7b": TransformerConfig.mixtral_8x7b,
+    }
+    if preset_or_json in presets:
+        cfg = presets[preset_or_json]()
+    elif preset_or_json.endswith(".json"):
+        with open(preset_or_json) as f:
+            raw = json.load(f)
+        # accept HF transformers config field names too
+        mapped = {
+            "vocab_size": raw.get("vocab_size", 32000),
+            "hidden_size": raw.get("hidden_size", 4096),
+            "intermediate_size": raw.get("intermediate_size", 11008),
+            "num_layers": raw.get("num_hidden_layers", raw.get("num_layers", 32)),
+            "num_heads": raw.get("num_attention_heads", raw.get("num_heads", 32)),
+            "num_kv_heads": raw.get("num_key_value_heads"),
+            "max_seq_len": raw.get("max_position_embeddings", 4096),
+        }
+        cfg = TransformerConfig(**mapped)
+    else:
+        raise ValueError(
+            f"unknown preset {preset_or_json!r}; options: {sorted(presets)} "
+            "or a config.json path"
+        )
+    model = CausalLM(cfg)
+    abstract = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32)),
+        jax.random.PRNGKey(0),
+    )
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(abstract))
+    itemsize = jnp.dtype(dtype).itemsize
+    inference = n_params * itemsize
+    # training: fp32 master + 2 AdamW moments (fp32) + compute-dtype cast
+    train = n_params * (4 + 8 + itemsize + (4 if grad_accum else 0))
+    return {
+        "params": n_params,
+        "largest_layer": max(
+            int(np.prod(l.shape)) * itemsize for l in jax.tree.leaves(abstract)
+        ),
+        "inference_bytes": inference,
+        "training_bytes": train,
+        "dtype": dtype,
+    }
+
+
+def estimate_command(args) -> None:
+    for dtype in args.dtypes:
+        info = estimate_from_config(args.model_name, dtype, args.grad_accum)
+        print(
+            f"{args.model_name} [{dtype}]: {info['params'] / 1e9:.2f}B params | "
+            f"inference {_human(info['inference_bytes'])} | "
+            f"training (AdamW) {_human(info['training_bytes'])} | "
+            f"largest layer {_human(info['largest_layer'])}"
+        )
+
+
+def estimate_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "estimate-memory", help="Estimate model memory usage"
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu estimate-memory")
+    parser.add_argument("model_name", help="Preset name or config.json path")
+    parser.add_argument("--dtypes", nargs="+", default=["bfloat16", "float32"])
+    parser.add_argument("--grad_accum", action="store_true")
+    if subparsers is not None:
+        parser.set_defaults(func=estimate_command)
+    return parser

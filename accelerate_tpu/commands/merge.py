@@ -1,0 +1,51 @@
+"""`accelerate-tpu merge-weights` — consolidate a sharded checkpoint.
+
+Parity: reference ``commands/merge.py`` (-> ``merge_fsdp_weights``
+utils/fsdp_utils.py:242). Our checkpoints are safetensors shards + index;
+merging = stream every shard into one file (or re-shard at a new size).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def merge_command(args) -> None:
+    from ..checkpointing import load_model_weights, shard_checkpoint, _save_named
+    from ..utils.constants import SAFE_WEIGHTS_INDEX_NAME, SAFE_WEIGHTS_NAME
+    import json
+
+    named = load_model_weights(args.checkpoint_dir)
+    os.makedirs(args.output_dir, exist_ok=True)
+    shards, index = shard_checkpoint(named, args.max_shard_size)
+    if index is None:
+        _save_named(shards[0], os.path.join(args.output_dir, SAFE_WEIGHTS_NAME))
+    else:
+        stem, ext = os.path.splitext(SAFE_WEIGHTS_NAME)
+        for i, shard in enumerate(shards):
+            _save_named(
+                shard,
+                os.path.join(
+                    args.output_dir, f"{stem}-{i + 1:05d}-of-{len(shards):05d}{ext}"
+                ),
+            )
+        with open(os.path.join(args.output_dir, SAFE_WEIGHTS_INDEX_NAME), "w") as f:
+            json.dump(index, f, indent=2, sort_keys=True)
+    print(f"Merged {len(named)} tensors into {args.output_dir}")
+
+
+def merge_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "merge-weights", help="Consolidate a sharded checkpoint"
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu merge-weights")
+    parser.add_argument("checkpoint_dir")
+    parser.add_argument("output_dir")
+    parser.add_argument("--max_shard_size", default="1000GB",
+                        help="Use e.g. 5GB to re-shard instead of merging")
+    if subparsers is not None:
+        parser.set_defaults(func=merge_command)
+    return parser

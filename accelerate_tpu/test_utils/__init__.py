@@ -1,0 +1,84 @@
+"""Testing harness shipped with the package.
+
+Parity: reference ``test_utils/testing.py`` (623 LoC): ``require_*`` skip
+decorators, ``AccelerateTestCase`` singleton reset, tensor comparison
+helpers, subprocess runner.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import unittest
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def require_tpu(test_case):
+    """Skip unless a real TPU backend is present (reference :241)."""
+    return unittest.skipUnless(
+        jax.default_backend() == "tpu", "test requires TPU"
+    )(test_case)
+
+
+def require_multi_device(test_case):
+    """Skip unless >1 device (real or host-platform fake) (reference :282)."""
+    return unittest.skipUnless(
+        jax.device_count() > 1, "test requires multiple devices"
+    )(test_case)
+
+
+def require_multi_process(test_case):
+    return unittest.skipUnless(
+        jax.process_count() > 1, "test requires multiple processes"
+    )(test_case)
+
+
+class AccelerateTestCase(unittest.TestCase):
+    """Resets singleton state between tests (reference :429)."""
+
+    def tearDown(self):
+        super().tearDown()
+        from ..state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+
+
+def are_the_same_tensors(tensor: Any) -> bool:
+    """Gather across processes and compare (reference :476)."""
+    from ..utils.operations import gather
+
+    gathered = np.asarray(gather(tensor))
+    per = np.asarray(tensor)
+    n = gathered.shape[0] // per.shape[0] if per.ndim else 1
+    for i in range(n):
+        chunk = gathered[i * per.shape[0]: (i + 1) * per.shape[0]]
+        if not np.allclose(chunk, gathered[: per.shape[0]], atol=1e-6):
+            return False
+    return True
+
+
+def execute_subprocess_async(cmd: list[str], env=None, timeout=600) -> str:
+    """Run a child process, raising with its output on failure
+    (reference :544)."""
+    result = subprocess.run(
+        cmd, env=env or os.environ.copy(), capture_output=True, text=True,
+        timeout=timeout,
+    )
+    if result.returncode != 0:
+        raise RuntimeError(
+            f"Command {' '.join(cmd)} failed (rc={result.returncode}):\n"
+            f"stdout: {result.stdout}\nstderr: {result.stderr}"
+        )
+    return result.stdout
+
+
+def path_in_accelerate_package(*components: str) -> str:
+    import accelerate_tpu
+
+    return os.path.join(os.path.dirname(accelerate_tpu.__file__), *components)

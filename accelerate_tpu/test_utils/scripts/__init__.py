@@ -1,0 +1,1 @@
+"""Runnable distributed-assertion scripts (reference test_utils/scripts/)."""

@@ -1,0 +1,53 @@
+"""Importable worker functions for debug_launcher-based multi-process tests
+(spawned children resolve these by qualified name; reference keeps its
+equivalents in test_utils/scripts for the same reason)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def collective_worker():
+    """Assert real cross-process collectives under the debug launcher."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import PartialState
+    from accelerate_tpu.utils.operations import broadcast, gather, reduce
+
+    state = PartialState()
+    assert state.num_processes > 1, "expected multi-process"
+    total = reduce(jnp.ones(()), "sum")
+    np.testing.assert_allclose(np.asarray(total), state.num_processes)
+    g = gather(jnp.asarray([float(state.process_index)]))
+    np.testing.assert_allclose(
+        np.sort(np.asarray(g)), np.arange(state.num_processes, dtype=np.float32)
+    )
+    b = broadcast(jnp.asarray([41.0 + state.process_index]))
+    np.testing.assert_allclose(np.asarray(b), [41.0])  # rank0's value wins
+
+
+def training_worker():
+    """Multi-process regression training equivalence (reference
+    test_script.py:420 training_check under the launcher)."""
+    import optax
+
+    from accelerate_tpu import Accelerator, DataLoader
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        regression_init,
+        regression_loss,
+    )
+
+    acc = Accelerator()
+    ds = RegressionDataset(length=64, seed=3)
+    dl = acc.prepare_data_loader(DataLoader(ds, batch_size=8))
+    opt = acc.prepare(optax.sgd(0.1))
+    params = acc.prepare(regression_init())
+    carry = acc.init_carry(params, opt)
+    step = acc.unified_step(regression_loss)
+    for _ in range(15):
+        for batch in dl:
+            carry, _ = step(carry, batch)
+    a = float(np.asarray(carry["params"]["a"]))
+    assert abs(a - 2.0) < 0.3, a

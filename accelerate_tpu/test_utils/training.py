@@ -1,0 +1,37 @@
+"""Toy models/data used across tests (reference test_utils/training.py:
+RegressionModel/RegressionDataset)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class RegressionDataset:
+    """y = a*x + b + noise, indexable like a torch Dataset (reference :*)."""
+
+    def __init__(self, a=2.0, b=3.0, length=64, seed=42):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.a, self.b = a, b
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + 0.05 * rng.normal(size=(length,))).astype(
+            np.float32
+        )
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def regression_init(seed: int = 0) -> dict:
+    del seed
+    return {"a": jnp.zeros(()), "b": jnp.zeros(())}
+
+
+def regression_loss(params, batch):
+    pred = params["a"] * batch["x"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)

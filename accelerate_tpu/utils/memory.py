@@ -1,0 +1,96 @@
+"""OOM handling: release + auto batch-size search.
+
+Parity: reference ``utils/memory.py`` (``release_memory``:29,
+``should_reduce_batch_size``:69, ``find_executable_batch_size``:87 — the
+decorator that halves the batch size on OOM and reruns). On TPU the OOM
+signal is an ``XlaRuntimeError`` with RESOURCE_EXHAUSTED / "Ran out of
+memory in memory space hbm" raised at compile OR first execution time.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Any, Callable, Optional
+
+import jax
+
+
+def release_memory(*objects) -> list:
+    """Drop references and device buffers (reference :29)."""
+    cleared = []
+    for obj in objects:
+        jax.tree.map(
+            lambda x: x.delete() if isinstance(x, jax.Array) else None, obj
+        )
+        cleared.append(None)
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    return cleared
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """Whether the exception is an accelerator OOM (reference :69)."""
+    markers = (
+        "RESOURCE_EXHAUSTED",
+        "Ran out of memory",
+        "Out of memory",
+        "Attempting to reserve",
+        "exceeds the memory available",
+        "Exceeded hbm capacity",
+    )
+    msg = str(exception)
+    return any(m in msg for m in markers)
+
+
+def find_executable_batch_size(
+    function: Optional[Callable] = None,
+    starting_batch_size: int = 128,
+) -> Callable:
+    """Decorator: run ``function(batch_size, *args)``, halving batch_size and
+    retrying whenever the accelerator OOMs (reference :87).
+
+    Usage::
+
+        @find_executable_batch_size(starting_batch_size=64)
+        def train(batch_size, ...): ...
+    """
+    if function is None:
+        return functools.partial(
+            find_executable_batch_size, starting_batch_size=starting_batch_size
+        )
+
+    batch_size_holder = [starting_batch_size]
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        params = list(inspect.signature(function).parameters.keys())
+        if not params or params[0] != "batch_size":
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the "
+                "first argument, but its signature must start with "
+                f"`batch_size` (got {params})"
+            )
+        while True:
+            if batch_size_holder[0] == 0:
+                raise RuntimeError(
+                    "No executable batch size found, reached zero."
+                )
+            try:
+                return function(batch_size_holder[0], *args, **kwargs)
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    gc.collect()
+                    try:
+                        jax.clear_caches()
+                    except Exception:
+                        pass
+                    batch_size_holder[0] //= 2
+                else:
+                    raise
+
+    return wrapper

@@ -1,0 +1,145 @@
+"""Disk offload tier: numpy memmaps + lazy index.
+
+Parity: reference ``utils/offload.py`` (``offload_weight``/
+``load_offloaded_weight`` :25,46, ``offload_state_dict`` :85,
+``PrefixedDataset`` :104, ``OffloadedWeightsLoader`` :127,
+``extract_submodules_state_dict`` :194). Same on-disk format: one ``.dat``
+memmap per tensor + ``index.json`` with shape/dtype.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections.abc import Mapping
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _safe_filename(weight_name: str) -> str:
+    """Flattened-pytree keys contain ``//``; keep filenames flat."""
+    return weight_name.replace("/", "_")
+
+
+def offload_weight(
+    weight: np.ndarray, weight_name: str, offload_folder: str, index: Optional[dict] = None
+) -> dict:
+    """Write one tensor to a memmap; returns its index entry (reference :25)."""
+    os.makedirs(offload_folder, exist_ok=True)
+    dtype = str(weight.dtype)
+    # bfloat16 has no numpy memmap dtype: store bits as int16 (reference
+    # stores torch bf16 via int16 views too)
+    if dtype == "bfloat16":
+        weight = weight.view(np.int16) if hasattr(weight, "view") else np.asarray(weight).view(np.int16)
+    file_path = os.path.join(offload_folder, f"{_safe_filename(weight_name)}.dat")
+    arr = np.memmap(file_path, dtype=weight.dtype, mode="w+", shape=weight.shape or (1,))
+    arr[:] = weight.reshape(weight.shape or (1,))[:]
+    arr.flush()
+    entry = {"dtype": dtype, "shape": list(weight.shape)}
+    if index is not None:
+        index[weight_name] = entry
+    return entry
+
+
+def load_offloaded_weight(weight_file: str, weight_info: dict) -> np.ndarray:
+    """Read one tensor back (reference :46)."""
+    shape = tuple(weight_info["shape"]) or (1,)
+    dtype = weight_info["dtype"]
+    np_dtype = np.int16 if dtype == "bfloat16" else np.dtype(dtype)
+    arr = np.memmap(weight_file, dtype=np_dtype, mode="r", shape=shape)
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        return np.asarray(arr).view(jnp.bfloat16.dtype)
+    return np.asarray(arr)
+
+
+def save_offload_index(index: dict, offload_folder: str) -> None:
+    os.makedirs(offload_folder, exist_ok=True)
+    path = os.path.join(offload_folder, "index.json")
+    current = {}
+    if os.path.isfile(path):
+        with open(path) as f:
+            current = json.load(f)
+    current.update(index)
+    with open(path, "w") as f:
+        json.dump(current, f, indent=2)
+
+
+def offload_state_dict(save_dir: str, state_dict: Mapping[str, Any]) -> None:
+    """Offload a whole named-tensor dict (reference :85)."""
+    index: dict = {}
+    for name, tensor in state_dict.items():
+        offload_weight(np.asarray(tensor), name, save_dir, index)
+    save_offload_index(index, save_dir)
+
+
+class OffloadedWeightsLoader(Mapping):
+    """Lazy Mapping over in-memory tensors + a disk offload folder
+    (reference :127): reading a key materializes only that tensor."""
+
+    def __init__(
+        self,
+        state_dict: Optional[Mapping[str, Any]] = None,
+        save_folder: Optional[str] = None,
+        index: Optional[Mapping[str, dict]] = None,
+    ):
+        if state_dict is None and save_folder is None and index is None:
+            raise ValueError("need state_dict and/or save_folder")
+        self.state_dict = dict(state_dict or {})
+        self.save_folder = save_folder
+        if index is None and save_folder is not None:
+            with open(os.path.join(save_folder, "index.json")) as f:
+                index = json.load(f)
+        self.index = dict(index or {})
+        self.all_keys = list(self.state_dict)
+        self.all_keys.extend(k for k in self.index if k not in self.all_keys)
+
+    def __getitem__(self, key: str):
+        if key in self.state_dict:
+            return self.state_dict[key]
+        weight_info = self.index[key]
+        weight_file = os.path.join(
+            self.save_folder, f"{_safe_filename(key)}.dat"
+        )
+        return load_offloaded_weight(weight_file, weight_info)
+
+    def __iter__(self):
+        return iter(self.all_keys)
+
+    def __len__(self):
+        return len(self.all_keys)
+
+
+class PrefixedDataset(Mapping):
+    """View of a Mapping under a key prefix (reference :104)."""
+
+    def __init__(self, dataset: Mapping, prefix: str):
+        self.dataset = dataset
+        self.prefix = prefix
+
+    def __getitem__(self, key):
+        return self.dataset[f"{self.prefix}{key}"]
+
+    def __iter__(self):
+        return iter(
+            k[len(self.prefix):] for k in self.dataset if k.startswith(self.prefix)
+        )
+
+    def __len__(self):
+        return sum(1 for k in self.dataset if k.startswith(self.prefix))
+
+
+def extract_submodules_state_dict(state_dict: Mapping, submodule_names: list[str]) -> dict:
+    """Sub-dict for the given prefixes (reference :194)."""
+    result = {}
+    for name in submodule_names:
+        result.update(
+            {
+                k: v
+                for k, v in state_dict.items()
+                if k == name or k.startswith(name + ".") or k.startswith(name + "//")
+            }
+        )
+    return result

@@ -1,0 +1,193 @@
+"""Big-model machinery tests — models reference tests/test_big_modeling.py
+(1050 LoC) and test_modeling_utils.py (773): abstract init, size
+computation, auto device maps, tiered dispatch, checkpoint streaming, and
+the OOM-retry decorator."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.big_modeling import (
+    check_device_map,
+    compute_module_sizes,
+    cpu_offload,
+    disk_offload,
+    dispatch_params,
+    get_balanced_memory,
+    get_max_memory,
+    infer_auto_device_map,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+)
+from accelerate_tpu.checkpointing import save_model_weights
+from accelerate_tpu.models import CausalLM, TransformerConfig
+from accelerate_tpu.utils.memory import (
+    find_executable_batch_size,
+    release_memory,
+    should_reduce_batch_size,
+)
+from accelerate_tpu.utils.offload import (
+    OffloadedWeightsLoader,
+    PrefixedDataset,
+    offload_state_dict,
+)
+
+
+def _params():
+    return {
+        "embed": {"w": jnp.ones((64, 32))},
+        "layer1": {"kernel": jnp.ones((32, 32)), "bias": jnp.zeros((32,))},
+        "layer2": {"kernel": jnp.ones((32, 32)), "bias": jnp.zeros((32,))},
+        "head": {"w": jnp.ones((32, 64))},
+    }
+
+
+def test_init_empty_weights_allocates_nothing():
+    cfg = TransformerConfig.tiny()
+    model = CausalLM(cfg)
+    abstract = init_empty_weights(
+        model.init, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    leaves = jax.tree.leaves(abstract)
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+    assert len(leaves) > 5
+
+
+def test_compute_module_sizes():
+    sizes = compute_module_sizes(_params())
+    assert sizes[""] == sum(
+        int(np.prod(l.shape)) * 4 for l in jax.tree.leaves(_params())
+    )
+    assert sizes["layer1"] == (32 * 32 + 32) * 4
+    assert sizes["layer1//kernel"] == 32 * 32 * 4
+
+
+def test_get_max_memory_override_and_probe():
+    mm = get_max_memory({0: "1GB", "cpu": 2 * 2**30})
+    assert mm == {0: 2**30, "cpu": 2 * 2**30}
+    probed = get_max_memory()
+    assert "cpu" in probed and 0 in probed and probed[0] > 0
+
+
+def test_infer_auto_device_map_spills_tiers():
+    params = _params()
+    # budget fits embed only on device 0; rest spills to cpu then disk
+    sizes = compute_module_sizes(params)
+    mm = {0: sizes["embed"] + 64, "cpu": sizes["layer1"] + 64}
+    dm = infer_auto_device_map(params, mm)
+    assert dm["embed//w"] == 0
+    assert dm["layer1//kernel"] == "cpu"
+    # later groups must be on disk
+    assert dm["head//w"] == "disk"
+    check_device_map(params, dm)
+
+
+def test_dispatch_and_reload_disk(tmp_path):
+    params = _params()
+    dm = {"embed": 0, "layer1": "cpu", "layer2": "disk", "head": 0}
+    placed = dispatch_params(params, dm, offload_dir=str(tmp_path))
+    assert isinstance(placed["embed"]["w"], jax.Array)
+    assert isinstance(placed["layer1"]["kernel"], (np.ndarray, jax.Array))
+    assert placed["layer2"]["kernel"] is None  # on disk
+    loader = OffloadedWeightsLoader(save_folder=str(tmp_path))
+    np.testing.assert_allclose(
+        loader["layer2//kernel"], np.asarray(params["layer2"]["kernel"])
+    )
+
+
+def test_cpu_and_disk_offload_whole_tree(tmp_path):
+    params = _params()
+    host = cpu_offload(params)
+    assert all(
+        isinstance(l, (np.ndarray, jax.Array)) for l in jax.tree.leaves(host)
+    )
+    disk = disk_offload(params, str(tmp_path))
+    assert os.path.isfile(tmp_path / "index.json")
+
+
+def test_load_checkpoint_and_dispatch_gspmd(tmp_path):
+    """The TPU-idiomatic path: stream safetensors straight onto mesh
+    shardings (no hooks)."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils.dataclasses import ParallelismPlugin
+
+    acc = Accelerator(
+        parallelism_plugin=ParallelismPlugin(fsdp_size=8, min_weight_size=16)
+    )
+    params = _params()
+    save_model_weights(params, str(tmp_path))
+    abstract = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), params
+    )
+    loaded = load_checkpoint_and_dispatch(
+        abstract, str(tmp_path), mesh=acc.mesh,
+        plugin=acc.state.parallelism_plugin,
+    )
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    k = loaded["layer1"]["kernel"]
+    assert "fsdp" in jax.tree.leaves(tuple(k.sharding.spec))
+
+
+def test_load_checkpoint_and_dispatch_device_map(tmp_path):
+    params = _params()
+    save_model_weights(params, str(tmp_path / "ckpt"))
+    abstract = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), params
+    )
+    loaded = load_checkpoint_and_dispatch(
+        abstract, str(tmp_path / "ckpt"), device_map={"": 0},
+    )
+    np.testing.assert_allclose(
+        np.asarray(loaded["head"]["w"]), np.asarray(params["head"]["w"])
+    )
+
+
+def test_offload_state_dict_roundtrip(tmp_path):
+    sd = {"a": np.arange(6, dtype=np.float32).reshape(2, 3), "b": np.ones((4,))}
+    offload_state_dict(str(tmp_path), sd)
+    loader = OffloadedWeightsLoader(save_folder=str(tmp_path))
+    assert set(loader) == {"a", "b"}
+    np.testing.assert_allclose(loader["a"], sd["a"])
+    pre = PrefixedDataset(loader, "a")
+    assert len(pre) == 1
+
+
+def test_should_reduce_batch_size():
+    assert should_reduce_batch_size(
+        RuntimeError("RESOURCE_EXHAUSTED: Ran out of memory in memory space hbm")
+    )
+    assert not should_reduce_batch_size(ValueError("shape mismatch"))
+
+
+def test_find_executable_batch_size():
+    tried = []
+
+    @find_executable_batch_size(starting_batch_size=16)
+    def train(batch_size):
+        tried.append(batch_size)
+        if batch_size > 4:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Ran out of memory")
+        return batch_size
+
+    assert train() == 4
+    assert tried == [16, 8, 4]
+
+
+def test_find_executable_batch_size_requires_arg():
+    @find_executable_batch_size(starting_batch_size=8)
+    def bad(x):
+        return x
+
+    with pytest.raises(TypeError):
+        bad()
+
+
+def test_release_memory():
+    x = jnp.ones((8, 8))
+    release_memory(x)
+    assert x.is_deleted()

@@ -1,0 +1,138 @@
+"""CLI tests — models reference tests/test_cli.py (516 LoC): config
+round-trip, launch env synthesis, estimate, merge, env dump, and the
+in-package test_script running single-process."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.commands.config import (
+    ClusterConfig,
+    write_basic_config,
+)
+from accelerate_tpu.commands.estimate import estimate_from_config
+from accelerate_tpu.utils.constants import ENV_PREFIX
+
+
+def test_cluster_config_roundtrip(tmp_path):
+    cfg = ClusterConfig(mixed_precision="fp16", tp_size=4, fsdp_size=2)
+    path = cfg.save(str(tmp_path / "cfg.json"))
+    loaded = ClusterConfig.load(path)
+    assert loaded.mixed_precision == "fp16"
+    assert loaded.tp_size == 4 and loaded.fsdp_size == 2
+
+
+def test_write_basic_config(tmp_path):
+    path = write_basic_config(save_location=str(tmp_path / "c.yaml"))
+    assert os.path.isfile(path)
+    loaded = ClusterConfig.load(path)
+    assert loaded.mixed_precision == "bf16"
+
+
+def test_config_env_transport():
+    cfg = ClusterConfig(tp_size=2, sp_size=4, gradient_accumulation_steps=8)
+    env = cfg.to_env()
+    assert env[ENV_PREFIX + "TP_SIZE"] == "2"
+    assert env[ENV_PREFIX + "SP_SIZE"] == "4"
+    assert env[ENV_PREFIX + "GRADIENT_ACCUMULATION_STEPS"] == "8"
+
+
+def test_multihost_env_transport():
+    cfg = ClusterConfig(
+        num_machines=4, machine_rank=2, main_process_ip="10.0.0.1",
+        main_process_port=1234,
+    )
+    env = cfg.to_env()
+    assert env[ENV_PREFIX + "NUM_PROCESSES"] == "4"
+    assert env[ENV_PREFIX + "COORDINATOR_ADDRESS"] == "10.0.0.1:1234"
+
+
+def test_estimate_presets():
+    info = estimate_from_config("tiny", "bfloat16")
+    assert info["params"] > 1e5
+    big = estimate_from_config("llama3-8b", "bfloat16")
+    assert 7.5e9 < big["params"] < 8.5e9
+    # training state ~14x params bytes at bf16 compute (4+8+2)
+    assert big["training_bytes"] >= big["params"] * 14
+
+
+def test_estimate_from_hf_config_json(tmp_path):
+    cfg = {
+        "vocab_size": 1000, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "max_position_embeddings": 128,
+    }
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps(cfg))
+    info = estimate_from_config(str(p))
+    assert info["params"] < 1e6
+
+
+def test_cli_help_lists_subcommands():
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "--help"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0
+    for cmd in ("config", "launch", "env", "estimate-memory", "merge-weights", "test"):
+        assert cmd in out.stdout
+
+
+def test_env_command_runs():
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "env"],
+        capture_output=True, text=True, env={**os.environ},
+    )
+    assert out.returncode == 0
+    assert "accelerate_tpu version" in out.stdout
+
+
+def test_merge_command(tmp_path):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.checkpointing import load_model_weights, save_model_weights
+
+    params = {"a": jnp.ones((64, 64)), "b": jnp.zeros((128,))}
+    save_model_weights(params, str(tmp_path / "sharded"), max_shard_size="8KB")
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "merge-weights", str(tmp_path / "sharded"), str(tmp_path / "merged")],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    named = load_model_weights(str(tmp_path / "merged"))
+    np.testing.assert_allclose(named["a"], np.ones((64, 64)))
+
+
+def test_launch_simple(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, json\n"
+        f"print(json.dumps({{k: v for k, v in os.environ.items() if k.startswith('{ENV_PREFIX}')}}))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "launch", "--tp_size", "2", "--mixed_precision", "fp16", str(script)],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    env = json.loads(out.stdout.strip().splitlines()[-1])
+    assert env[ENV_PREFIX + "TP_SIZE"] == "2"
+    assert env[ENV_PREFIX + "MIXED_PRECISION"] == "fp16"
+
+
+def test_in_package_test_script_single_process():
+    from accelerate_tpu.test_utils import path_in_accelerate_package
+
+    script = path_in_accelerate_package("test_utils", "scripts", "test_script.py")
+    out = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+        timeout=560,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "All checks passed!" in out.stdout

@@ -1,0 +1,26 @@
+"""Launcher tests — the reference's debug-launcher pattern (tests/
+test_grad_sync.py:35 debug_launcher(...)): real multi-process collectives
+on localhost CPU."""
+
+import pytest
+
+from accelerate_tpu.launchers import debug_launcher, notebook_launcher
+from accelerate_tpu.test_utils.scripts.multiprocess_worker import (
+    collective_worker,
+    training_worker,
+)
+
+
+def test_notebook_launcher_single_process():
+    out = notebook_launcher(lambda x: x * 2, (21,), num_processes=1)
+    assert out == 42
+
+
+@pytest.mark.slow
+def test_debug_launcher_collectives():
+    debug_launcher(collective_worker, num_processes=2)
+
+
+@pytest.mark.slow
+def test_debug_launcher_training():
+    debug_launcher(training_worker, num_processes=2)

@@ -128,6 +128,52 @@ def test_sharded_training_decreases_loss(plugin_kw):
     assert all(np.isfinite(l) for l in losses)
 
 
+def test_sequence_parallel_training_matches_dp():
+    """Ring-attention context parallelism must produce the same loss/params
+    as the plain path on the same global batch (the capability the reference
+    lacks — SURVEY.md §2.4 CP row)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg_ref = TransformerConfig.tiny(num_layers=2, max_seq_len=64)
+    cfg_ring = TransformerConfig.tiny(
+        num_layers=2, max_seq_len=64, attention_impl="ring"
+    )
+    variables = CausalLM(cfg_ref).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )
+    batch = _batch(cfg_ref, bs=4, seq=64)
+
+    def run(cfg, plugin, shard_batch):
+        acc = Accelerator(parallelism_plugin=plugin)
+        model = CausalLM(cfg)
+        opt = acc.prepare(optax.sgd(0.1))
+        params = acc.prepare(jax.tree.map(jnp.copy, variables["params"]))
+        carry = acc.init_carry(params, opt)
+        step = acc.unified_step(CausalLM.loss_fn(model))
+        b = batch
+        if shard_batch:
+            b = jax.device_put(
+                batch, NamedSharding(acc.mesh, P("dp", "sp"))
+            )
+        carry, m = step(carry, b)
+        return float(m["loss"]), carry["params"]
+
+    loss_ref, p_ref = run(
+        cfg_ref, ParallelismPlugin(dp_size=8), shard_batch=False
+    )
+    from accelerate_tpu import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    loss_ring, p_ring = run(
+        cfg_ring, ParallelismPlugin(dp_size=2, sp_size=4), shard_batch=True
+    )
+    assert abs(loss_ref - loss_ring) < 1e-4, (loss_ref, loss_ring)
+    for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_ring)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
 def test_grad_accum_equivalence_model():
     """accum=2 over half-batches == accum=1 over the full batch (the
     reference's test_sync.py semantics, on a real model)."""
