@@ -1,0 +1,123 @@
+"""Autoregressive generation with a static-shape KV cache.
+
+The inference counterpart of the reference's big-model benchmark surface
+(BASELINE.md measures s/token generation; reference drives HF
+``model.generate``). TPU-native design: prefill is one forward over the
+prompt; the decode loop is a single ``lax.scan`` over token steps — one
+compiled program for the whole generation, no per-token dispatch.
+
+Sampling: greedy, temperature, top-k, top-p (nucleus).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import CausalLM
+
+
+def _sample_logits(logits, key, temperature, top_k, top_p):
+    """(B, V) logits -> (B,) token ids."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p; cutoff is the logit of
+        # the last token inside that set
+        include = cum - probs < top_p
+        cutoff = jnp.min(
+            jnp.where(include, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(
+    model: CausalLM,
+    params: Any,
+    input_ids: jax.Array,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_token_id: Optional[int] = None,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Generate continuations; returns (B, prompt_len + max_new_tokens).
+
+    The prompt must fit ``config.max_seq_len - max_new_tokens``. After an
+    EOS, positions are padded with EOS (finished sequences stop changing).
+    """
+    B, prompt_len = input_ids.shape
+    if prompt_len + max_new_tokens > model.config.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_seq_len ({model.config.max_seq_len})"
+        )
+    key = key if key is not None else jax.random.PRNGKey(0)
+    cache = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32), decode=True
+    )["cache"]
+
+    # prefill the whole prompt in one forward
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, input_ids, decode=True,
+        mutable=["cache"],
+    )
+    cache = mutated["cache"]
+    first = _sample_logits(logits[:, -1], key, temperature, top_k, top_p)
+
+    def step(carry, _):
+        cache, token, k, done = carry
+        k, sub = jax.random.split(k)
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, token[:, None], decode=True,
+            mutable=["cache"],
+        )
+        nxt = _sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, eos_token_id, nxt)
+            done = done | (nxt == eos_token_id)
+        return (mutated["cache"], nxt, k, done), nxt
+
+    done = (
+        (first == eos_token_id)
+        if eos_token_id is not None
+        else jnp.zeros((B,), bool)
+    )
+    if max_new_tokens > 1:
+        (_, _, _, _), rest = jax.lax.scan(
+            step, (cache, first, key, done), None, length=max_new_tokens - 1
+        )
+        new_tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
+    else:
+        new_tokens = first[:, None]
+    return jnp.concatenate([input_ids, new_tokens], axis=1)
+
+
+def make_generate_fn(
+    model: CausalLM,
+    max_new_tokens: int = 32,
+    **sample_kwargs,
+):
+    """A jitted generate closure: ``fn(params, input_ids, key) -> ids``.
+    Compile once, call per batch (static prompt length)."""
+
+    @jax.jit
+    def fn(params, input_ids, key=None):
+        return generate(
+            model, params, input_ids, max_new_tokens=max_new_tokens,
+            key=key, **sample_kwargs,
+        )
+
+    return fn

@@ -74,9 +74,11 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 class Attention(nn.Module):
     config: TransformerConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions, mask=None):
+        decode = self.decode
         cfg = self.config
         dtype = _dtype(cfg)
         q_dim = cfg.num_heads * cfg.head_dim
@@ -101,11 +103,56 @@ class Attention(nn.Module):
         q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
-        out = dot_product_attention(
-            q, k, v, mask=mask, causal=True, implementation=cfg.attention_impl
-        )
+
+        if decode:
+            # KV-cache decode (flax decode-cache pattern): a fixed-size
+            # per-layer cache collection, updated in place at cache_index.
+            # Static shapes throughout — XLA-friendly autoregression.
+            # The has_variable guard keeps the init pass from running the
+            # update body (it would advance cache_index on creation).
+            max_len = cfg.max_seq_len
+            is_initialized = self.has_variable("cache", "cached_key")
+            cached_key = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros((b, max_len, cfg.num_kv_heads, cfg.head_dim), k.dtype),
+            )
+            cached_value = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros((b, max_len, cfg.num_kv_heads, cfg.head_dim), v.dtype),
+            )
+            cache_index = self.variable(
+                "cache", "cache_index", lambda: jnp.asarray(0, jnp.int32)
+            )
+            decode = is_initialized
+        if decode:
+            idx = cache_index.value
+            positions = idx + jnp.arange(s)[None, :]  # (1, s) broadcasts over batch
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+            key_cache = jax.lax.dynamic_update_slice(
+                cached_key.value, k, (0, idx, 0, 0)
+            )
+            value_cache = jax.lax.dynamic_update_slice(
+                cached_value.value, v, (0, idx, 0, 0)
+            )
+            cached_key.value = key_cache
+            cached_value.value = value_cache
+            cache_index.value = idx + s
+            # attend over the full cache, masking positions not yet written:
+            # col j visible to query i (global pos idx+i) iff j <= idx+i
+            cols = jnp.arange(max_len)[None, None, None, :]
+            rows = (idx + jnp.arange(s))[None, None, :, None]
+            dec_mask = cols <= rows  # (1,1,s,max_len)
+            out = dot_product_attention(
+                q, key_cache, value_cache, mask=dec_mask, causal=False,
+                implementation="xla",
+            )
+        else:
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+            out = dot_product_attention(
+                q, k, v, mask=mask, causal=True, implementation=cfg.attention_impl
+            )
         # named residual: the "save_attn" remat policy keeps exactly these,
         # so backward never recomputes the attention kernel
         out = checkpoint_name(out, "attn_out")
@@ -208,11 +255,14 @@ class MoE(nn.Module):
 
 class Block(nn.Module):
     config: TransformerConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions, mask=None):
         cfg = self.config
-        h = x + Attention(cfg, name="attn")(RMSNorm(cfg, name="attn_norm")(x), positions, mask)
+        h = x + Attention(cfg, decode=self.decode, name="attn")(
+            RMSNorm(cfg, name="attn_norm")(x), positions, mask
+        )
         ff = MoE(cfg, name="moe") if cfg.num_experts > 0 else MLP(cfg, name="mlp")
         return h + ff(RMSNorm(cfg, name="mlp_norm")(h)), None
 
@@ -226,7 +276,7 @@ class CausalLM(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, mask=None):
+    def __call__(self, input_ids, positions=None, mask=None, decode=False):
         cfg = self.config
         dtype = _dtype(cfg)
         if positions is None:
@@ -263,15 +313,17 @@ class CausalLM(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 block_cls,
-                variable_axes={"params": 0, "intermediates": 0},
+                variable_axes={"params": 0, "intermediates": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")(x, positions, mask)
+            )(cfg, decode=decode, name="layers")(x, positions, mask)
         else:
             for i in range(cfg.num_layers):
-                x, _ = block_cls(cfg, name=f"layer_{i}")(x, positions, mask)
+                x, _ = block_cls(cfg, decode=decode, name=f"layer_{i}")(
+                    x, positions, mask
+                )
 
         x = RMSNorm(cfg, name="final_norm")(x)
         # logits matmul stays in the compute dtype (bf16 on the MXU — fp32
