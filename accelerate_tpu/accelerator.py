@@ -467,7 +467,21 @@ class Accelerator:
             return new_carry, metrics
 
         donate_args = (0,) if (donate and self.compile_plugin.donate_state) else ()
-        return jax.jit(_step, donate_argnums=donate_args)
+        jitted = jax.jit(_step, donate_argnums=donate_args)
+
+        def step_fn(carry, batch, **kw):
+            out = jitted(carry, batch, **kw)
+            # Host mirrors, no device sync: the micro/opt progression is
+            # deterministic from the call count (overflow skips hold params
+            # but still advance the counters), so accelerator.step,
+            # sync_gradients and the schedulers stay correct in a
+            # unified_step loop (save_state then records the true step).
+            self.step += 1
+            self.gradient_state.sync_gradients = self.step % num_accum == 0
+            return out
+
+        step_fn.jitted = jitted  # escape hatch: no host-mirror bookkeeping
+        return step_fn
 
     def init_carry(
         self, params: Any, optimizer: Optional[AcceleratedOptimizer] = None
@@ -495,6 +509,15 @@ class Accelerator:
         if policy.uses_loss_scaling:
             carry["loss_scale"] = init_loss_scale(policy)
         return carry
+
+    def sync_from_carry(self, carry: dict) -> None:
+        """Force host mirrors (``step``, ``sync_gradients``) to the carry's
+        device counters. One host read — call on checkpoint/log boundaries
+        when the call-count mirror may be stale (e.g. after load_state)."""
+        micro = int(np.asarray(carry["micro_step"]))
+        opt = int(np.asarray(carry["opt_step"]))
+        self.step = opt * self.gradient_state.num_steps + micro
+        self.gradient_state.sync_gradients = micro == 0
 
     # ------------------------------------------------------------------ #
     # raw-loop parity API (eager path)

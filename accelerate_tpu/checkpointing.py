@@ -14,7 +14,9 @@ restore is "fill an abstract template and device_put onto the template's
 shardings" — the sharded-restore path that FSDP needs ``dist_cp`` for
 (reference utils/fsdp_utils.py:60-215) falls out of NamedSharding here.
 Host-side state (python/numpy RNG, schedulers, samplers, custom objects)
-keeps the reference's file layout so resume semantics match 1:1.
+keeps the reference's file-per-object naming scheme; formats differ (json /
+safetensors here vs torch pickles there), so checkpoints are not byte-level
+interchangeable with the reference.
 """
 
 from __future__ import annotations
@@ -80,8 +82,24 @@ def unflatten_into(template: Any, named: dict[str, Any]) -> Any:
         if key not in named:
             raise KeyError(f"checkpoint missing tensor {key!r}")
         value = named[key]
-        if isinstance(tleaf, jax.Array) and hasattr(tleaf, "sharding"):
-            value = jax.device_put(jnp.asarray(value, tleaf.dtype), tleaf.sharding)
+        if isinstance(tleaf, jax.Array):
+            value = jnp.asarray(value, tleaf.dtype)
+            if value.shape != tleaf.shape:
+                # only 1-element leaves may be reshaped (scalar counters the
+                # file format stores as (1,)); anything else is corruption
+                # and must fail loudly, not silently scramble a kernel
+                if value.size == tleaf.size and value.size == 1:
+                    value = value.reshape(tleaf.shape)
+                else:
+                    raise ValueError(
+                        f"checkpoint tensor {key!r} has shape {value.shape}, "
+                        f"template expects {tleaf.shape}"
+                    )
+            if isinstance(tleaf.sharding, jax.sharding.NamedSharding):
+                value = jax.device_put(value, tleaf.sharding)
+            # non-Named shardings (e.g. scalar counters from init_carry):
+            # keep the array uncommitted so jit may co-locate it freely —
+            # committing to one device breaks multi-device steps.
         leaves.append(value)
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
@@ -210,10 +228,11 @@ def load_model_weights(load_directory: str) -> dict[str, np.ndarray]:
 def _checkpoint_dir(accelerator, output_dir: Optional[str]) -> str:
     """Resolve automatic naming/rotation (reference accelerator.py:2880-2915).
 
-    Rotation and the already-exists guard run on the main process only —
-    save_state is a collective call (all processes write their RNG shard),
-    so non-main processes must not race on rmtree or trip over the
-    directory the main process just created.
+    Rotation runs on the main process only; the already-exists guard then
+    runs on EVERY process between two barriers (first so rotation is done,
+    second so no process reaches makedirs while another is still checking)
+    — a main-only raise would leave the other processes hanging at the
+    next collective instead of failing everywhere.
     """
     pc = accelerator.project_configuration
     if pc.automatic_checkpoint_naming:
@@ -228,12 +247,14 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str]) -> str:
                         f"Deleting {stale} to respect total_limit={pc.total_limit}"
                     )
                     shutil.rmtree(stale, ignore_errors=True)
-            if os.path.exists(out):
-                raise ValueError(
-                    f"Checkpoint directory {out} already exists — either load "
-                    "it first or set a fresh ProjectConfiguration.iteration."
-                )
         accelerator.wait_for_everyone()
+        exists = os.path.exists(out)
+        accelerator.wait_for_everyone()
+        if exists:
+            raise ValueError(
+                f"Checkpoint directory {out} already exists — either load "
+                "it first or set a fresh ProjectConfiguration.iteration."
+            )
         return out
     if output_dir is None:
         raise ValueError("output_dir required without automatic_checkpoint_naming")
@@ -306,6 +327,9 @@ def save_accelerator_state(
         for i, obj in enumerate(accelerator._custom_objects):
             with open(os.path.join(output_dir, f"{CUSTOM_STATE_NAME}_{i}.pkl"), "wb") as f:
                 pickle.dump(obj.state_dict(), f)
+        if carry is not None and "opt_step" in carry:
+            # the carry's device counters are the source of truth
+            accelerator.sync_from_carry(carry)
         meta = {
             "step": accelerator.step,
             "iteration": accelerator.project_configuration.iteration,
@@ -410,6 +434,8 @@ def load_accelerator_state(
 
     if "step" in meta:
         accelerator.step = int(meta["step"])
+    if carry is not None and isinstance(result, dict) and "opt_step" in result:
+        accelerator.sync_from_carry(result)
     if "iteration" in meta:
         accelerator.project_configuration.iteration = int(meta["iteration"]) + 1
     return result

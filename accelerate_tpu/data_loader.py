@@ -381,8 +381,14 @@ class DataLoaderShard(DataLoaderStateMixin):
                         if skipped < self._skip_batches:
                             skipped += 1
                             continue
+                        # host work only in this thread: collate/convert.
+                        # The device_put happens on the consumer thread —
+                        # concurrent jax dispatch from two threads can wedge
+                        # XLA:CPU collective rendezvous, and on TPU
+                        # device_put is async so the consumer-side put still
+                        # overlaps H2D with the running step.
                         host_batch = _to_numpy(host_batch)
-                        if not _put((self._device_put(host_batch, valid), valid)):
+                        if not _put((host_batch, valid)):
                             return
                     _put(stop)
                 except BaseException as e:  # surface producer errors
@@ -398,7 +404,8 @@ class DataLoaderShard(DataLoaderStateMixin):
                 nxt = q.get()
                 if isinstance(nxt, BaseException):
                     raise nxt
-                batch, valid = current
+                host_batch, valid = current
+                batch = self._device_put(host_batch, valid)
                 if self.global_batch_size == 0:
                     # iterable-of-batches path: learn the batch size from the
                     # first batch so the tail's remainder is detected

@@ -8,6 +8,11 @@ TP, SP or EP without edits — the whole point of the GSPMD redesign.
 """
 
 from .config import TransformerConfig
-from .transformer import CausalLM, count_params
+from .transformer import CausalLM, SequenceClassifier, count_params
 
-__all__ = ["TransformerConfig", "CausalLM", "count_params"]
+__all__ = [
+    "TransformerConfig",
+    "CausalLM",
+    "SequenceClassifier",
+    "count_params",
+]

@@ -25,6 +25,8 @@ class TransformerConfig:
     rope_theta: float = 500000.0
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    # False -> bidirectional self-attention (BERT-family encoders)
+    causal: bool = True
     attention_impl: Optional[str] = None  # None=auto | xla | flash | ring
     # MoE (Mixtral family); 0 experts = dense MLP
     num_experts: int = 0
@@ -55,6 +57,21 @@ class TransformerConfig:
         kw.setdefault("num_layers", 2)
         kw.setdefault("num_heads", 4)
         kw.setdefault("max_seq_len", 256)
+        return cls(**kw)
+
+    @classmethod
+    def bert_base(cls, **kw) -> "TransformerConfig":
+        """BERT-base shape (the reference's nlp_example.py fine-tune target,
+        examples/nlp_example.py: bert-base-cased). Bidirectional attention;
+        rope replaces learned positions — the TPU build's encoder idiom."""
+        kw.setdefault("vocab_size", 30522)
+        kw.setdefault("hidden_size", 768)
+        kw.setdefault("intermediate_size", 3072)
+        kw.setdefault("num_layers", 12)
+        kw.setdefault("num_heads", 12)
+        kw.setdefault("max_seq_len", 512)
+        kw.setdefault("causal", False)
+        kw.setdefault("tie_embeddings", True)
         return cls(**kw)
 
     @classmethod

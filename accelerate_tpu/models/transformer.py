@@ -151,7 +151,8 @@ class Attention(nn.Module):
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
             out = dot_product_attention(
-                q, k, v, mask=mask, causal=True, implementation=cfg.attention_impl
+                q, k, v, mask=mask, causal=cfg.causal,
+                implementation=cfg.attention_impl,
             )
         # named residual: the "save_attn" remat policy keeps exactly these,
         # so backward never recomputes the attention kernel
@@ -267,6 +268,57 @@ class Block(nn.Module):
         return h + ff(RMSNorm(cfg, name="mlp_norm")(h)), None
 
 
+def _make_embed(cfg: TransformerConfig, dtype) -> nn.Embed:
+    return nn.Embed(
+        cfg.vocab_size,
+        cfg.hidden_size,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        embedding_init=nn.with_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")
+        ),
+        name="embed",
+    )
+
+
+def _apply_layer_stack(cfg: TransformerConfig, x, positions, mask=None,
+                       decode=False):
+    """Run the block stack (scan or unrolled, optional remat) on hidden
+    states. Must be called inside an ``nn.compact`` context — the created
+    modules attach to the calling module's scope, so CausalLM and
+    SequenceClassifier share one implementation and one param layout."""
+    block_cls = Block
+    if cfg.remat:
+        policy = {
+            "full": None,
+            "dots": jax.checkpoint_policies.checkpoint_dots,
+            "dots_with_no_batch_dims": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            "save_attn": jax.checkpoint_policies.save_only_these_names(
+                "attn_out"
+            ),
+        }[cfg.remat]
+        block_cls = nn.remat(
+            Block, policy=policy, prevent_cse=not cfg.scan_layers,
+            static_argnums=(),
+        )
+
+    if cfg.scan_layers:
+        x, _ = nn.scan(
+            block_cls,
+            variable_axes={"params": 0, "intermediates": 0, "cache": 0},
+            split_rngs={"params": True},
+            in_axes=(nn.broadcast, nn.broadcast),
+            length=cfg.num_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(cfg, decode=decode, name="layers")(x, positions, mask)
+    else:
+        for i in range(cfg.num_layers):
+            x, _ = block_cls(cfg, decode=decode, name=f"layer_{i}")(
+                x, positions, mask
+            )
+    return x
+
+
 class CausalLM(nn.Module):
     """The language model: embed -> scan(Block) -> norm -> lm_head.
 
@@ -283,48 +335,9 @@ class CausalLM(nn.Module):
             positions = jnp.broadcast_to(
                 jnp.arange(input_ids.shape[1])[None, :], input_ids.shape
             )
-        embed = nn.Embed(
-            cfg.vocab_size,
-            cfg.hidden_size,
-            dtype=dtype,
-            param_dtype=jnp.float32,
-            embedding_init=nn.with_partitioning(
-                nn.initializers.normal(0.02), ("vocab", "embed")
-            ),
-            name="embed",
-        )
+        embed = _make_embed(cfg, dtype)
         x = embed(input_ids)
-
-        block_cls = Block
-        if cfg.remat:
-            policy = {
-                "full": None,
-                "dots": jax.checkpoint_policies.checkpoint_dots,
-                "dots_with_no_batch_dims": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-                "save_attn": jax.checkpoint_policies.save_only_these_names(
-                    "attn_out"
-                ),
-            }[cfg.remat]
-            block_cls = nn.remat(
-                Block, policy=policy, prevent_cse=not cfg.scan_layers,
-                static_argnums=(),
-            )
-
-        if cfg.scan_layers:
-            x, _ = nn.scan(
-                block_cls,
-                variable_axes={"params": 0, "intermediates": 0, "cache": 0},
-                split_rngs={"params": True},
-                in_axes=(nn.broadcast, nn.broadcast),
-                length=cfg.num_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, decode=decode, name="layers")(x, positions, mask)
-        else:
-            for i in range(cfg.num_layers):
-                x, _ = block_cls(cfg, decode=decode, name=f"layer_{i}")(
-                    x, positions, mask
-                )
-
+        x = _apply_layer_stack(cfg, x, positions, mask, decode=decode)
         x = RMSNorm(cfg, name="final_norm")(x)
         # logits matmul stays in the compute dtype (bf16 on the MXU — fp32
         # here costs ~4x on the biggest matmul); the loss upcasts to fp32
@@ -369,5 +382,83 @@ class CausalLM(nn.Module):
                 mask = mask[:, 1:].astype(jnp.float32)
                 return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
             return jnp.mean(nll)
+
+        return fn
+
+
+class SequenceClassifier(nn.Module):
+    """Encoder classifier — the BERT-family fine-tune target (reference
+    ``examples/nlp_example.py``: AutoModelForSequenceClassification on
+    bert-base). Same Block stack as CausalLM with ``config.causal=False``
+    (bidirectional self-attention); masked mean-pool + tanh pooler +
+    classification head replace the lm_head.
+
+    ``__call__(input_ids, attention_mask=None) -> (B, num_labels) logits``
+    with ``attention_mask`` 1 = real token, 0 = padding.
+    """
+
+    config: TransformerConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None):
+        cfg = self.config
+        dtype = _dtype(cfg)
+        b, s = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        attn_mask4d = None
+        if attention_mask is not None:
+            # (B, S) keep-mask -> (B, 1, 1, S): padded keys invisible to all
+            attn_mask4d = attention_mask[:, None, None, :] > 0
+        x = _make_embed(cfg, dtype)(input_ids)
+        x = _apply_layer_stack(cfg, x, positions, attn_mask4d)
+        x = RMSNorm(cfg, name="final_norm")(x)
+
+        if attention_mask is None:
+            pooled = jnp.mean(x, axis=1)
+        else:
+            w = attention_mask[:, :, None].astype(x.dtype)
+            pooled = jnp.sum(x * w, axis=1) / jnp.maximum(
+                jnp.sum(w, axis=1), 1.0
+            )
+        pooled = nn.tanh(
+            nn.Dense(
+                cfg.hidden_size,
+                dtype=dtype,
+                param_dtype=jnp.float32,
+                kernel_init=nn.with_partitioning(
+                    # ("embed", None): a square kernel must not map one mesh
+                    # axis to both dims (invalid PartitionSpec)
+                    nn.initializers.lecun_normal(), ("embed", None)
+                ),
+                name="pooler",
+            )(pooled)
+        )
+        # classifier logits in fp32: the softmax/CE is where precision matters
+        return nn.Dense(
+            self.num_labels,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("embed", None)
+            ),
+            name="classifier",
+        )(pooled)
+
+    @staticmethod
+    def loss_fn(model: "SequenceClassifier"):
+        """Cross-entropy closure for Accelerator.unified_step; batch keys:
+        {input_ids, labels, [attention_mask]}."""
+        import optax
+
+        def fn(params, batch):
+            logits = model.apply(
+                {"params": params},
+                batch["input_ids"],
+                batch.get("attention_mask"),
+            )
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), batch["labels"]
+            ).mean()
 
         return fn

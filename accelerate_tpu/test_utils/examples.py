@@ -1,0 +1,88 @@
+"""Example-drift comparison utilities.
+
+Parity: reference ``test_utils/examples.py`` (compare_against_test) — the
+machinery behind ExampleDifferenceTests (reference tests/test_examples.py:
+61): every ``examples/by_feature/*.py`` script must stay line-for-line in
+sync with the complete example, so feature demos can't drift from the
+canonical scripts.
+
+Mechanism (re-implemented for this repo's layout): extract a function's
+source lines from the base (``nlp_example.py``), the complete example and
+the feature example; the feature's *new* lines (those not in the base) must
+all appear among the complete example's new lines. Lines marked with a
+``TESTING_`` env-var guard are test-harness plumbing and are ignored.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+def extract_function(lines: List[str], name: str) -> List[str]:
+    """Source lines of ``def <name>`` up to the next top-level marker.
+
+    ``training_function`` runs until ``def compute_dtype`` (the shared
+    trailing helper); ``main`` runs until ``if __name__``.
+    """
+    if name == "training_function":
+        terminator = "def compute_dtype"
+    elif name == "main":
+        terminator = "if __name__"
+    else:
+        raise ValueError(
+            f"unsupported function {name!r}: choose 'training_function' or 'main'"
+        )
+    out, started = [], False
+    for line in lines:
+        if not started:
+            if f"def {name}" in line:
+                started = True
+                out.append(line)
+            continue
+        if terminator in line:
+            return out
+        out.append(line)
+    return out
+
+
+def clean_lines(lines: List[str]) -> List[str]:
+    """Drop comments, blank lines and TESTING_-guarded harness lines;
+    strip indentation (feature scripts may nest shared code differently,
+    e.g. under an ``if args.with_tracking:`` branch)."""
+    return [
+        line.strip()
+        for line in lines
+        if not line.lstrip().startswith("#")
+        and line.strip() != ""
+        and "TESTING_" not in line
+    ]
+
+
+def compare_against_test(
+    complete_filename: str,
+    feature_filename: str,
+    parser_only: bool,
+    base_filename: Optional[str] = None,
+) -> List[str]:
+    """Lines of ``feature_filename`` that are covered by NEITHER the base
+    example NOR the complete example — an empty return means no drift.
+
+    ``base_filename`` defaults to ``examples/nlp_example.py`` next to the
+    complete example.
+    """
+    if base_filename is None:
+        base_filename = os.path.join(
+            os.path.dirname(os.path.abspath(complete_filename)), "nlp_example.py"
+        )
+    name = "main" if parser_only else "training_function"
+    with open(complete_filename) as f:
+        complete = clean_lines(extract_function(f.readlines(), name))
+    with open(base_filename) as f:
+        base = clean_lines(extract_function(f.readlines(), name))
+    with open(feature_filename) as f:
+        feature = clean_lines(extract_function(f.readlines(), name))
+
+    feature_new = [line for line in feature if line not in base]
+    complete_new = [line for line in complete if line not in base]
+    return [line for line in feature_new if line not in complete_new]
