@@ -371,6 +371,39 @@ class Accelerator:
         policy = self.state.mixed_precision_policy
         num_accum = self.gradient_state.num_steps
         opt_transform = optimizer.optimizer
+        # Pin the output param/opt-state shardings to the parallelism plan:
+        # without this, GSPMD propagation may reshard outputs to follow other
+        # operands (e.g. ZeRO-1's sharded moments would drag the replicated
+        # params into fsdp shards after one step).
+        param_shardings = self._param_shardings
+
+        def _named_only(tree):
+            # scalar counters etc. carry SingleDeviceSharding — constraining
+            # to one device inside a multi-device jit is an error; pin only
+            # mesh-aware NamedSharding leaves and let XLA place the rest
+            return jax.tree.map(
+                lambda x: x.sharding
+                if isinstance(x, jax.Array) and isinstance(x.sharding, NamedSharding)
+                else None,
+                tree,
+            )
+
+        opt_shardings = (
+            _named_only(optimizer.opt_state)
+            if optimizer.opt_state is not None
+            else None
+        )
+
+        def _pin(tree, shardings):
+            if shardings is None:
+                return tree
+            return jax.tree.map(
+                lambda x, s: x
+                if s is None
+                else jax.lax.with_sharding_constraint(x, s),
+                tree,
+                shardings,
+            )
 
         def _step(carry: dict, batch: Any, **kw):
             params = carry["params"]
@@ -390,10 +423,19 @@ class Accelerator:
             grads, (loss, aux) = jax.grad(
                 lambda p: _scaled_loss(p, compute_batch), has_aux=True
             )(compute_params)
-            # accumulate in fp32 regardless of compute dtype
-            grads = _cast_floating(grads, jnp.float32)
+            # accumulate in grad_dtype (default fp32; bf16 halves the accum
+            # buffer HBM at some precision cost — the comm-hook tradeoff)
+            accum_dtype = jnp.dtype(policy.grad_dtype or jnp.float32)
+            grads = _cast_floating(grads, accum_dtype)
             if num_accum > 1:
                 accum = jax.tree.map(lambda a, g: a + g, carry["accum_grads"], grads)
+                zero2 = self._zero2_grad_shardings(accum)
+                if zero2 is not None:
+                    # ZeRO-2: pin the carried buffer to its fsdp shards so
+                    # the grad sum lowers to reduce-scatter, not all-reduce
+                    accum = jax.tree.map(
+                        jax.lax.with_sharding_constraint, accum, zero2
+                    )
             else:
                 accum = grads  # no buffer carried: saves 4 bytes/param HBM
             micro = micro + 1
@@ -415,6 +457,8 @@ class Accelerator:
                     mean_grads, opt_state, params
                 )
                 new_params = optax.apply_updates(params, updates)
+                new_params = _pin(new_params, param_shardings)
+                new_opt_state = _pin(new_opt_state, opt_shardings)
                 # fp16 overflow: keep old params/state (GradScaler skip)
                 new_params = jax.tree.map(
                     lambda n, o: jnp.where(finite, n, o), new_params, params
@@ -501,14 +545,35 @@ class Accelerator:
             "opt_step": jnp.asarray(0, jnp.int32),
         }
         if self.gradient_state.num_steps > 1:
-            carry["accum_grads"] = jax.jit(
-                lambda p: jax.tree.map(
-                    lambda x: jnp.zeros_like(x, dtype=jnp.float32), p
-                )
-            )(params)
+            accum_dtype = jnp.dtype(policy.grad_dtype or jnp.float32)
+            zeros = lambda p: jax.tree.map(
+                lambda x: jnp.zeros_like(x, dtype=accum_dtype), p
+            )
+            grad_shardings = self._zero2_grad_shardings(params)
+            if grad_shardings is not None:
+                # ZeRO-2: the carried grad buffer lives fsdp-sharded
+                carry["accum_grads"] = jax.jit(
+                    zeros, out_shardings=grad_shardings
+                )(params)
+            else:
+                carry["accum_grads"] = jax.jit(zeros)(params)
         if policy.uses_loss_scaling:
             carry["loss_scale"] = init_loss_scale(policy)
         return carry
+
+    def _zero2_grad_shardings(self, params: Any):
+        """Shardings for the accumulated-grad carry buffer under ZeRO-2
+        (SHARD_GRAD_OP), else None (buffer follows the params)."""
+        from .parallel.sharding import grad_buffer_shardings
+        from .utils.dataclasses import ShardingStrategy
+
+        plugin = self.state.parallelism_plugin
+        if (
+            plugin.sharding_strategy is not ShardingStrategy.SHARD_GRAD_OP
+            or self.mesh.shape.get("fsdp", 1) <= 1
+        ):
+            return None
+        return grad_buffer_shardings(params, self.mesh, plugin)
 
     def sync_from_carry(self, carry: dict) -> None:
         """Force host mirrors (``step``, ``sync_gradients``) to the carry's

@@ -107,13 +107,37 @@ class AcceleratedOptimizer:
     # functional core (used by Accelerator's compiled step)
     # ------------------------------------------------------------------ #
     def init(self, params: Any) -> Any:
-        """Create opt state sharded congruently with (already-sharded)
-        params: jit + out_shardings inferred by GSPMD from the param
-        shardings, so e.g. Adam moments of an fsdp-sharded kernel are
-        fsdp-sharded too (the ZeRO-1/2 capability)."""
-        # jit the init so XLA lays the opt state out following the params'
-        # shardings: each moment buffer inherits its param leaf's sharding.
-        self.opt_state = jax.jit(self.optimizer.init)(params)
+        """Create opt state sharded congruently with the parallelism plan.
+
+        * FULL_SHARD/HYBRID (ZeRO-3): jit without out_shardings — each
+          moment buffer inherits its (already fsdp-sharded) param leaf's
+          sharding via GSPMD propagation.
+        * SHARD_OPT/SHARD_GRAD_OP (ZeRO-1/2, reference DeepSpeed stages
+          utils/dataclasses.py:739): params are replicated, so propagation
+          would replicate the moments too; instead explicit out_shardings
+          shard every moment buffer over the fsdp axis.
+        """
+        from .utils.dataclasses import ShardingStrategy
+
+        plugin = getattr(self.accelerator_state, "parallelism_plugin", None)
+        mesh = getattr(self.accelerator_state, "mesh", None)
+        zero12 = (
+            plugin is not None
+            and mesh is not None
+            and plugin.sharding_strategy
+            in (ShardingStrategy.SHARD_OPT, ShardingStrategy.SHARD_GRAD_OP)
+            and mesh.shape.get("fsdp", 1) > 1
+        )
+        if zero12:
+            from .parallel.sharding import infer_opt_state_shardings
+
+            shapes = jax.eval_shape(self.optimizer.init, params)
+            out_shardings = infer_opt_state_shardings(shapes, mesh, plugin)
+            self.opt_state = jax.jit(
+                self.optimizer.init, out_shardings=out_shardings
+            )(params)
+        else:
+            self.opt_state = jax.jit(self.optimizer.init)(params)
         return self.opt_state
 
     def apply_gradients(self, grads: Any, params: Any, opt_state: Any):

@@ -5,3 +5,8 @@ from .mesh import (
     resolve_mesh_shape,
     single_device_mesh,
 )
+from .pipeline import (
+    pipeline_apply,
+    stacked_layer_shardings,
+    validate_pipeline_plugin,
+)

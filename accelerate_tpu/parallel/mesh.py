@@ -54,6 +54,10 @@ def build_mesh(
     innermost, fastest ICI ring.
     """
     plugin = plugin or ParallelismPlugin()
+    if plugin.pp_size not in (1, -1):
+        from .pipeline import validate_pipeline_plugin
+
+        validate_pipeline_plugin(plugin)
     if devices is None:
         devices = jax.devices()
     shape = resolve_mesh_shape(plugin, len(devices))

@@ -1,0 +1,167 @@
+"""Pipeline parallelism: a GPipe microbatch schedule over the ``pp`` mesh
+axis, built from ``shard_map`` + ``ppermute``.
+
+Parity: the reference reaches pipeline-parallel *training* only through
+Megatron-LM (``MegatronLMPlugin.pp_degree`` utils/dataclasses.py:1318, the
+pipelined ``train_step`` utils/megatron_lm.py:1037-1058) and inference
+through PiPPy (inference.py:126). TPU-native redesign (SURVEY §7.6): the
+layer stack is a *stacked array* (the ``nn.scan`` layout this repo's models
+already use), its layer dimension shards over the ``pp`` mesh axis, and one
+``shard_map`` program runs the classic GPipe schedule — each device group
+runs its layer block on microbatch ``t`` while ``ppermute`` rotates
+activations to the next stage. Backward falls out of jax.grad through the
+scan (reverse pipeline schedule), so the same ``unified_step`` trains a
+pipelined model with zero engine code.
+
+Composition rules (v1): pp composes with dp/fsdp batch sharding (the batch
+dim stays sharded inside the stage compute). tp/sp/ep *inside* a pipelined
+stage would need nested collectives under shard_map and are rejected
+loudly in :func:`validate_pipeline_plugin`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map  # jax >= 0.6
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..utils.constants import MESH_AXIS_PIPELINE
+from ..utils.dataclasses import ParallelismPlugin
+from .mesh import data_axes
+
+
+def validate_pipeline_plugin(plugin: ParallelismPlugin) -> None:
+    """pp>1 with tp/sp/ep>1 would need collectives nested inside the stage
+    shard_map — unsupported in v1, reject instead of silently mis-sharding."""
+    if plugin.pp_size in (1, -1):
+        return
+    bad = {
+        "tp_size": plugin.tp_size,
+        "sp_size": plugin.sp_size,
+        "ep_size": plugin.ep_size,
+    }
+    offending = {k: v for k, v in bad.items() if v not in (1,)}
+    if offending:
+        raise NotImplementedError(
+            f"pipeline parallelism (pp_size={plugin.pp_size}) cannot yet be "
+            f"combined with {offending}; use pp with dp/fsdp only"
+        )
+    if plugin.num_micro_batches < plugin.pp_size:
+        raise ValueError(
+            f"num_micro_batches ({plugin.num_micro_batches}) must be >= "
+            f"pp_size ({plugin.pp_size}) or the pipeline bubbles dominate"
+        )
+
+
+def stacked_layer_shardings(
+    stacked_params: Any, mesh: Mesh, layer_dim: int = 0
+) -> Any:
+    """NamedSharding pytree sharding each leaf's ``layer_dim`` over pp.
+
+    For params produced by ``nn.scan`` (leading layer dimension) this is the
+    whole pipeline placement: stage ``i`` holds layers
+    ``[i*L/S, (i+1)*L/S)`` in its HBM and nothing else.
+    """
+
+    def _one(leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) <= layer_dim or shape[layer_dim] % mesh.shape[MESH_AXIS_PIPELINE]:
+            return NamedSharding(mesh, P())
+        spec = [None] * len(shape)
+        spec[layer_dim] = MESH_AXIS_PIPELINE
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree.map(_one, stacked_params)
+
+
+def pipeline_apply(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_micro_batches: int,
+    batch_dim: int = 0,
+) -> jax.Array:
+    """Run a stacked layer sequence as a GPipe pipeline over the pp axis.
+
+    ``block_fn(local_layers, x_micro) -> y_micro`` applies this stage's
+    layer block (leaves have leading dim ``num_layers // pp``) to one
+    microbatch; it must preserve ``x_micro``'s shape (a residual-block
+    stack). ``stacked_params`` leaves carry a leading ``num_layers`` dim.
+    ``x``: activations, microbatched along ``batch_dim``.
+
+    Equivalent to sequentially applying all layers; wall-clock is
+    ``(M + S - 1)/M`` of ideal with M microbatches, S stages.
+    """
+    S = mesh.shape[MESH_AXIS_PIPELINE]
+    M = num_micro_batches
+    if S == 1:
+        return block_fn(stacked_params, x)
+    B = x.shape[batch_dim]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+
+    # (B, ...) -> (M, B/M, ...) microbatch-major
+    xm = jnp.moveaxis(x, batch_dim, 0).reshape(
+        (M, B // M) + x.shape[:batch_dim] + x.shape[batch_dim + 1:]
+    )
+
+    batch_axes = data_axes(mesh)
+    # microbatch dim replicated; per-microbatch batch dim keeps data sharding
+    x_spec = P(None, batch_axes if mesh.shape[batch_axes[0]] > 1 else None)
+    param_specs = jax.tree.map(
+        lambda l: P(MESH_AXIS_PIPELINE), stacked_params
+    )
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        check_rep=False,
+    )
+    def _pipelined(local_params, local_xm):
+        stage = jax.lax.axis_index(MESH_AXIS_PIPELINE)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 consumes microbatch t (clamped once the feed is done)
+            feed = jax.lax.dynamic_index_in_dim(
+                local_xm, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            inp = jnp.where(stage == 0, feed, state)
+            y = block_fn(local_params, inp)
+            # last stage owns microbatch t-(S-1) once the pipe is full
+            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            prev = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+            write = jnp.logical_and(stage == S - 1, t >= S - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(write, y, prev), out_idx, 0
+            )
+            # rotate activations one stage forward
+            state = jax.lax.ppermute(y, MESH_AXIS_PIPELINE, perm)
+            return (state, outputs), None
+
+        init = (
+            jnp.zeros_like(local_xm[0]),
+            jnp.zeros_like(local_xm),
+        )
+        (state, outputs), _ = jax.lax.scan(
+            tick, init, jnp.arange(M + S - 1)
+        )
+        # only the last stage holds real outputs; sum-broadcast over pp
+        outputs = jnp.where(stage == S - 1, outputs, 0)
+        return jax.lax.psum(outputs, MESH_AXIS_PIPELINE)
+
+    ym = _pipelined(stacked_params, xm)
+    y = ym.reshape((B,) + ym.shape[2:])
+    return jnp.moveaxis(y, 0, batch_dim) if batch_dim != 0 else y

@@ -181,6 +181,43 @@ def infer_param_shardings(
     )
 
 
+def infer_opt_state_shardings(
+    opt_state_shapes: Any,
+    mesh: Mesh,
+    plugin: Optional[ParallelismPlugin] = None,
+) -> Any:
+    """NamedSharding pytree for an optimizer state under ZeRO-1/2
+    (``ShardingStrategy.SHARD_OPT`` / ``SHARD_GRAD_OP``): moment buffers
+    shard over the fsdp axis while the params stay replicated — the
+    DeepSpeed stage-1/2 capability (reference utils/dataclasses.py:739)
+    expressed as out_shardings on ``optax.init``.
+
+    ``opt_state_shapes``: the (abstract) opt-state pytree, e.g. from
+    ``jax.eval_shape(opt.init, params)``. Scalars/small leaves (schedule
+    counts) replicate via the ``min_weight_size`` threshold.
+    """
+    plugin = plugin or ParallelismPlugin()
+    fsdp_size = mesh.shape[MESH_AXIS_FSDP]
+
+    def _one(leaf):
+        return NamedSharding(
+            mesh, _fsdp_spec_for_leaf(leaf, fsdp_size, plugin.min_weight_size)
+        )
+
+    return jax.tree.map(_one, opt_state_shapes)
+
+
+def grad_buffer_shardings(
+    params: Any,
+    mesh: Mesh,
+    plugin: Optional[ParallelismPlugin] = None,
+) -> Any:
+    """NamedSharding pytree for the accumulated-grad carry buffer under
+    ZeRO-2 (``SHARD_GRAD_OP``): grads reduce-scatter into fsdp shards
+    instead of living replicated between micro-steps."""
+    return infer_opt_state_shardings(params, mesh, plugin)
+
+
 def shard_params(
     params: Any,
     shardings: Any,

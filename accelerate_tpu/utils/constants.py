@@ -25,14 +25,18 @@ DEFAULT_CONFIG_DIR = "~/.cache/accelerate_tpu"
 
 # Mesh axis naming convention used across the whole framework. Order matters:
 # outer-to-inner device placement (dp outermost so DCN traffic rides the
-# data axis; tp innermost so its collectives stay on the fastest ICI links).
+# data axis; pp next — stage hops are one activation tensor per microbatch,
+# the cheapest recurring traffic, so pipeline stages may span slices; tp
+# innermost so its collectives stay on the fastest ICI links).
 MESH_AXIS_DATA = "dp"
+MESH_AXIS_PIPELINE = "pp"
 MESH_AXIS_FSDP = "fsdp"
 MESH_AXIS_EXPERT = "ep"
 MESH_AXIS_SEQUENCE = "sp"
 MESH_AXIS_TENSOR = "tp"
 MESH_AXES = (
     MESH_AXIS_DATA,
+    MESH_AXIS_PIPELINE,
     MESH_AXIS_FSDP,
     MESH_AXIS_EXPERT,
     MESH_AXIS_SEQUENCE,
