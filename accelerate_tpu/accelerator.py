@@ -375,7 +375,6 @@ class Accelerator:
         # without this, GSPMD propagation may reshard outputs to follow other
         # operands (e.g. ZeRO-1's sharded moments would drag the replicated
         # params into fsdp shards after one step).
-        param_shardings = self._param_shardings
 
         def _named_only(tree):
             # scalar counters etc. carry SingleDeviceSharding — constraining
@@ -388,11 +387,17 @@ class Accelerator:
                 tree,
             )
 
-        opt_shardings = (
-            _named_only(optimizer.opt_state)
-            if optimizer.opt_state is not None
-            else None
-        )
+        def _opt_shardings():
+            # Resolved lazily INSIDE _step (i.e. at trace time, on the first
+            # step call): the step can only run with a carry from
+            # init_carry, which guarantees optimizer.init has happened by
+            # then — capturing at build time would silently disable ZeRO-1/2
+            # pinning when unified_step is built before init_carry.
+            return (
+                _named_only(optimizer.opt_state)
+                if optimizer.opt_state is not None
+                else None
+            )
 
         def _pin(tree, shardings):
             if shardings is None:
@@ -457,8 +462,10 @@ class Accelerator:
                     mean_grads, opt_state, params
                 )
                 new_params = optax.apply_updates(params, updates)
-                new_params = _pin(new_params, param_shardings)
-                new_opt_state = _pin(new_opt_state, opt_shardings)
+                # self._param_shardings read at trace time for the same
+                # build-order reason as _opt_shardings
+                new_params = _pin(new_params, self._param_shardings)
+                new_opt_state = _pin(new_opt_state, _opt_shardings())
                 # fp16 overflow: keep old params/state (GradScaler skip)
                 new_params = jax.tree.map(
                     lambda n, o: jnp.where(finite, n, o), new_params, params

@@ -274,6 +274,7 @@ def save_accelerator_state(
     carry: Any = None,
     params: Any = None,
     safe_serialization: bool = True,
+    sharded: bool = True,
 ) -> str:
     """Serialize the entire training state (reference checkpointing.py:51).
 
@@ -281,6 +282,13 @@ def save_accelerator_state(
     (params + opt state + counters [+ loss scale]); alternatively pass bare
     ``params``. Custom registered objects, schedulers, dataloader positions
     and host RNG are saved alongside, file-per-object like the reference.
+
+    ``sharded=True`` (default) uses the distributed per-process format
+    (:mod:`accelerate_tpu.dist_checkpoint`): each host writes only the
+    shards it owns — the FSDP ``SHARDED_STATE_DICT`` capability (reference
+    utils/fsdp_utils.py:60-215), required for models that do not fit one
+    host's RAM. ``sharded=False`` falls back to a rank-0 single-file
+    export (all-gathers everything to every host first).
     """
     output_dir = _checkpoint_dir(accelerator, output_dir)
     os.makedirs(output_dir, exist_ok=True)
@@ -292,17 +300,22 @@ def save_accelerator_state(
     if tree is None and accelerator._models:
         tree = accelerator._models[0]
     if tree is not None:
-        named = flatten_tree(_to_host(tree))
-        if is_main:
-            arrays = {k: v for k, v in named.items() if _is_arraylike(v)}
-            _save_named(
-                arrays,
-                os.path.join(
-                    output_dir,
-                    SAFE_WEIGHTS_NAME if safe_serialization else MODEL_NAME + ".bin",
-                ),
-                safe_serialization,
-            )
+        if sharded:
+            from .dist_checkpoint import save_sharded_tree
+
+            save_sharded_tree(tree, output_dir)
+        else:
+            named = flatten_tree(_to_host(tree))
+            if is_main:
+                arrays = {k: v for k, v in named.items() if _is_arraylike(v)}
+                _save_named(
+                    arrays,
+                    os.path.join(
+                        output_dir,
+                        SAFE_WEIGHTS_NAME if safe_serialization else MODEL_NAME + ".bin",
+                    ),
+                    safe_serialization,
+                )
 
     # --- optimizer states not inside the carry (raw-loop usage) ---
     if carry is None:
@@ -388,12 +401,19 @@ def load_accelerator_state(
     template = carry if carry is not None else params
     result = None
     if template is not None:
-        named = load_model_weights(input_dir)
-        # non-array leaves (counters saved as arrays) restore fine; anything
-        # missing in the file falls back to the template's current value.
-        flat_template = flatten_tree(template)
-        merged = {k: named.get(k, v) for k, v in flat_template.items()}
-        result = unflatten_into(template, merged)
+        from .dist_checkpoint import is_sharded_checkpoint, load_sharded_tree
+
+        if is_sharded_checkpoint(input_dir):
+            # strict=False: leaves absent from the file keep the template's
+            # value (legacy merge semantics, e.g. a new loss_scale leaf)
+            result = load_sharded_tree(template, input_dir, strict=False)
+        else:
+            named = load_model_weights(input_dir)
+            # non-array leaves (counters saved as arrays) restore fine;
+            # anything missing falls back to the template's current value.
+            flat_template = flatten_tree(template)
+            merged = {k: named.get(k, v) for k, v in flat_template.items()}
+            result = unflatten_into(template, merged)
 
     if carry is None:
         for i, opt in enumerate(accelerator._optimizers):

@@ -16,7 +16,12 @@ def merge_command(args) -> None:
     from ..utils.constants import SAFE_WEIGHTS_INDEX_NAME, SAFE_WEIGHTS_NAME
     import json
 
-    named = load_model_weights(args.checkpoint_dir)
+    from ..dist_checkpoint import is_sharded_checkpoint, load_full_named
+
+    if is_sharded_checkpoint(args.checkpoint_dir):
+        named = load_full_named(args.checkpoint_dir)
+    else:
+        named = load_model_weights(args.checkpoint_dir)
     os.makedirs(args.output_dir, exist_ok=True)
     shards, index = shard_checkpoint(named, args.max_shard_size)
     if index is None:

@@ -1,0 +1,316 @@
+"""Distributed (per-process sharded) checkpoint format.
+
+Parity: the reference's FSDP ``SHARDED_STATE_DICT`` path — each rank saves
+only the shards it owns and restore re-assembles onto the live sharding
+(``utils/fsdp_utils.py:60-215``, ``torch.distributed.checkpoint`` directory
+format). TPU-native redesign: a jax.Array already knows its global shape,
+its ``NamedSharding`` and which shards this process holds, so the format is
+simply
+
+* ``state_shard_{proc:05d}.safetensors`` — every locally-owned chunk of
+  every leaf, written by process ``proc``. A chunk is one device shard with
+  ``replica_id == 0`` (exactly one replica writes each distinct piece of
+  data, so the union over processes tiles each global array exactly once).
+* ``state_index_{proc:05d}.json`` — that process's chunk manifest:
+  ``key -> {shape, dtype, chunks: [{file, stored, offset, shape}]}``.
+
+Restore reads the merged manifests and builds each leaf with
+``jax.make_array_from_callback``: every device asks only for its own slice,
+which is assembled from the overlapping on-disk chunks via safetensors'
+``get_slice`` partial reads. No process ever materializes a full array —
+the property the reference needs ``dist_cp`` for and that makes
+Llama-70B-class checkpoints writable from hosts whose RAM holds only their
+own shards. A shared filesystem across hosts is assumed, like the
+reference's ``dist_cp`` directory format.
+
+safetensors >= 0.8 stores bfloat16/fp8 numpy (ml_dtypes) arrays
+natively, so no bit-casting is needed.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+SHARD_FILE_PATTERN = "state_shard_{:05d}.safetensors"
+INDEX_FILE_PATTERN = "state_index_{:05d}.json"
+
+def _normalize_index(index, shape) -> tuple[tuple[int, int], ...]:
+    """A shard ``index`` (tuple of slices) -> ((start, stop), ...) with
+    Nones resolved against the global shape."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        if step != 1:
+            raise ValueError(f"non-unit-stride shard slice {sl}")
+        out.append((start, stop))
+    return tuple(out)
+
+
+def save_sharded_tree(
+    tree: Any, output_dir: str, process_index: Optional[int] = None
+) -> None:
+    """Write this process's owned chunks of every leaf in ``tree``.
+
+    Every process must call this (it is collective only through the
+    filesystem); each writes its own pair of files. Leaves that are not
+    jax.Arrays (host numpy/python scalars) are owned by process 0.
+    """
+    from .checkpointing import flatten_tree
+
+    proc = jax.process_index() if process_index is None else process_index
+    os.makedirs(output_dir, exist_ok=True)
+    named = flatten_tree(tree)
+
+    tensors: dict[str, np.ndarray] = {}
+    manifest: dict[str, dict] = {}
+    fname = SHARD_FILE_PATTERN.format(proc)
+    for key, leaf in named.items():
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+            shape = leaf.shape
+            dtype = str(leaf.dtype)
+            chunks = []
+            for i, shard in enumerate(leaf.addressable_shards):
+                if shard.replica_id != 0:
+                    continue
+                data = np.asarray(shard.data)
+                stored = f"{key}@{i}"
+                tensors[stored] = np.ascontiguousarray(data)
+                bounds = _normalize_index(
+                    shard.index, shape
+                ) if shard.index else ()
+                chunks.append(
+                    {
+                        "file": fname,
+                        "stored": stored,
+                        "offset": [b[0] for b in bounds],
+                        "shape": list(data.shape),
+                    }
+                )
+            if not chunks:
+                continue  # another process owns every replica-0 shard
+            manifest[key] = {
+                "shape": list(shape),
+                "dtype": dtype,
+                "chunks": chunks,
+            }
+        elif proc == 0:
+            if leaf is None or not (
+                isinstance(leaf, (np.ndarray, jax.Array)) or np.isscalar(leaf)
+            ):
+                continue  # non-tensor leaf (config objects etc.) — skipped,
+                # like the legacy path's _is_arraylike filter; restore keeps
+                # the template's value via strict=False
+            data = np.asarray(leaf)
+            if data.dtype.kind in "USO":  # strings / bytes / objects
+                continue
+            dtype = str(data.dtype)
+            stored = f"{key}@0"
+            tensors[stored] = np.ascontiguousarray(data)
+            manifest[key] = {
+                "shape": list(data.shape),
+                "dtype": dtype,
+                "chunks": [
+                    {
+                        "file": fname,
+                        "stored": stored,
+                        "offset": [0] * data.ndim,
+                        "shape": list(data.shape),
+                    }
+                ],
+            }
+
+    from safetensors.numpy import save_file
+
+    save_file(tensors, os.path.join(output_dir, fname))
+    with open(os.path.join(output_dir, INDEX_FILE_PATTERN.format(proc)), "w") as f:
+        json.dump(manifest, f)
+    logger.debug(
+        f"process {proc}: wrote {len(tensors)} chunks of {len(manifest)} leaves"
+    )
+
+
+def is_sharded_checkpoint(input_dir: str) -> bool:
+    return bool(glob.glob(os.path.join(input_dir, "state_index_*.json")))
+
+
+def _merged_manifest(input_dir: str) -> dict[str, dict]:
+    merged: dict[str, dict] = {}
+    for path in sorted(glob.glob(os.path.join(input_dir, "state_index_*.json"))):
+        with open(path) as f:
+            frag = json.load(f)
+        for key, entry in frag.items():
+            if key in merged:
+                merged[key]["chunks"].extend(entry["chunks"])
+            else:
+                merged[key] = entry
+    if not merged:
+        raise FileNotFoundError(f"no state_index_*.json under {input_dir}")
+    return merged
+
+
+class _FileCache:
+    """Open each safetensors shard file once per restore, not once per
+    chunk — the restore path touches O(leaves x device-shards) chunks and
+    a per-chunk safe_open would hammer a network filesystem with metadata
+    round-trips."""
+
+    def __init__(self, input_dir: str):
+        self.input_dir = input_dir
+        self._open: dict[str, Any] = {}
+
+    def get(self, fname: str):
+        if fname not in self._open:
+            from safetensors import safe_open
+
+            self._open[fname] = safe_open(
+                os.path.join(self.input_dir, fname), framework="numpy"
+            ).__enter__()
+        return self._open[fname]
+
+    def close(self):
+        for handle in self._open.values():
+            handle.__exit__(None, None, None)
+        self._open.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _read_region(
+    files: _FileCache,
+    entry: dict,
+    bounds: tuple[tuple[int, int], ...],
+) -> np.ndarray:
+    """Assemble the half-open region ``bounds`` of one leaf from the
+    overlapping on-disk chunks, reading only the required slices."""
+    import ml_dtypes  # noqa: F401  (registers bfloat16/fp8 numpy dtypes)
+
+    np_dtype = np.dtype(entry["dtype"])
+    region_shape = tuple(b[1] - b[0] for b in bounds)
+    out = np.empty(region_shape, dtype=np_dtype)
+    filled = 0
+    for chunk in entry["chunks"]:
+        c_off = chunk["offset"]
+        c_shape = chunk["shape"]
+        # overlap of [c_off, c_off+c_shape) with bounds, per dim
+        lo = [max(b[0], o) for b, o in zip(bounds, c_off)]
+        hi = [
+            min(b[1], o + s) for b, o, s in zip(bounds, c_off, c_shape)
+        ]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        src = tuple(
+            slice(l - o, h - o) for l, h, o in zip(lo, hi, c_off)
+        )
+        dst = tuple(
+            slice(l - b[0], h - b[0]) for l, h, b in zip(lo, hi, bounds)
+        )
+        f = files.get(chunk["file"])
+        if src:
+            piece = f.get_slice(chunk["stored"])[src]
+        else:  # 0-dim leaf: the slicing API needs at least one dim,
+            # and get_tensor returns 0-dim tensors as shape (1,)
+            piece = f.get_tensor(chunk["stored"]).reshape(())
+        out[dst] = piece
+        filled += int(np.prod([h - l for l, h in zip(lo, hi)]))
+    if filled != int(np.prod(region_shape)):
+        raise ValueError(
+            f"checkpoint chunks cover {filled} of "
+            f"{int(np.prod(region_shape))} elements for a region of "
+            f"shape {region_shape} — incomplete checkpoint?"
+        )
+    return out
+
+
+def load_full_named(input_dir: str) -> dict[str, np.ndarray]:
+    """Assemble every leaf of a sharded checkpoint into full host arrays
+    (the export/merge path — the one place full materialization is the
+    point; reference ``merge_fsdp_weights`` utils/fsdp_utils.py:242)."""
+    manifest = _merged_manifest(input_dir)
+    with _FileCache(input_dir) as files:
+        return {
+            key: _read_region(
+                files, entry, tuple((0, d) for d in entry["shape"])
+            )
+            for key, entry in manifest.items()
+        }
+
+
+def load_sharded_tree(
+    template: Any, input_dir: str, strict: bool = True
+) -> Any:
+    """Fill ``template`` (a pytree of jax.Arrays / ShapeDtypeStructs) from a
+    sharded checkpoint, each device reading only its own slice.
+
+    Template leaves with a ``NamedSharding`` are built with
+    ``jax.make_array_from_callback`` (per-device partial reads); other
+    leaves (host scalars, single-device arrays) are assembled whole —
+    they are small by construction.
+
+    ``strict=False`` keeps the template's current value for leaves the
+    checkpoint does not contain (e.g. resuming an fp32 checkpoint into an
+    fp16 run whose carry grew a ``loss_scale``) — the legacy single-file
+    loader's merge semantics.
+    """
+    from .checkpointing import _path_str
+
+    manifest = _merged_manifest(input_dir)
+    files = _FileCache(input_dir)
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, tleaf in paths_and_leaves:
+        key = _path_str(path)
+        if key not in manifest:
+            if strict:
+                raise KeyError(f"sharded checkpoint missing tensor {key!r}")
+            leaves.append(tleaf)
+            continue
+        entry = manifest[key]
+        shape = tuple(entry["shape"])
+        t_shape = tuple(getattr(tleaf, "shape", shape))
+        if shape != t_shape and not (
+            int(np.prod(shape)) == int(np.prod(t_shape)) == 1
+        ):
+            raise ValueError(
+                f"checkpoint tensor {key!r} has shape {shape}, template "
+                f"expects {t_shape}"
+            )
+        sharding = getattr(tleaf, "sharding", None)
+        t_dtype = getattr(tleaf, "dtype", None)
+
+        def _cast(arr):
+            return arr.astype(t_dtype) if t_dtype is not None else arr
+
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            value = jax.make_array_from_callback(
+                t_shape,
+                sharding,
+                lambda idx, e=entry, s=shape, c=_cast: jnp.asarray(
+                    c(_read_region(files, e, _normalize_index(idx, s)))
+                ),
+            )
+        else:
+            full = _read_region(
+                files, entry, tuple((0, d) for d in shape)
+            ).reshape(t_shape)
+            value = jnp.asarray(_cast(full))
+        leaves.append(value)
+    result = jax.tree_util.tree_unflatten(treedef, leaves)
+    # make_array_from_callback runs its callbacks eagerly, so every read
+    # has happened by now and the handles can be closed.
+    files.close()
+    return result

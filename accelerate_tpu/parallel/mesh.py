@@ -54,13 +54,14 @@ def build_mesh(
     innermost, fastest ICI ring.
     """
     plugin = plugin or ParallelismPlugin()
-    if plugin.pp_size not in (1, -1):
-        from .pipeline import validate_pipeline_plugin
-
-        validate_pipeline_plugin(plugin)
     if devices is None:
         devices = jax.devices()
     shape = resolve_mesh_shape(plugin, len(devices))
+    if shape["pp"] > 1:
+        from .pipeline import validate_pipeline_plugin
+
+        # validate on RESOLVED degrees so pp_size=-1 can't skip the check
+        validate_pipeline_plugin(plugin, resolved_shape=shape)
     dims = tuple(shape[a] for a in MESH_AXES)
     device_array = np.asarray(devices).reshape(dims)
     return Mesh(device_array, MESH_AXES)

@@ -28,35 +28,61 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 try:
-    from jax import shard_map  # jax >= 0.6
+    from jax import shard_map as _shard_map  # jax >= 0.6
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-checking kwarg was renamed check_rep -> check_vma in
+# jax 0.7; detect from the actual signature rather than guessing by import
+import inspect as _inspect
+
+_REP_KWARG = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f=None, **kwargs):
+    if "check_rep" in kwargs:
+        kwargs[_REP_KWARG] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs) if f is not None else _shard_map(**kwargs)
 
 from ..utils.constants import MESH_AXIS_PIPELINE
 from ..utils.dataclasses import ParallelismPlugin
 from .mesh import data_axes
 
 
-def validate_pipeline_plugin(plugin: ParallelismPlugin) -> None:
+def validate_pipeline_plugin(
+    plugin: ParallelismPlugin, resolved_shape: Optional[dict] = None
+) -> None:
     """pp>1 with tp/sp/ep>1 would need collectives nested inside the stage
-    shard_map — unsupported in v1, reject instead of silently mis-sharding."""
-    if plugin.pp_size in (1, -1):
+    shard_map — unsupported in v1, reject instead of silently mis-sharding.
+
+    ``resolved_shape`` (from ``resolve_mesh_shape``) covers the ``-1`` auto
+    axes — validation must run on the *resolved* degrees, else ``pp_size=-1``
+    slips past every check.
+    """
+    sizes = (
+        {"pp": resolved_shape["pp"], "tp_size": resolved_shape["tp"],
+         "sp_size": resolved_shape["sp"], "ep_size": resolved_shape["ep"]}
+        if resolved_shape is not None
+        else {"pp": plugin.pp_size, "tp_size": plugin.tp_size,
+              "sp_size": plugin.sp_size, "ep_size": plugin.ep_size}
+    )
+    pp = sizes.pop("pp")
+    if pp in (1, -1):
         return
-    bad = {
-        "tp_size": plugin.tp_size,
-        "sp_size": plugin.sp_size,
-        "ep_size": plugin.ep_size,
-    }
-    offending = {k: v for k, v in bad.items() if v not in (1,)}
+    offending = {k: v for k, v in sizes.items() if v not in (1,)}
     if offending:
         raise NotImplementedError(
-            f"pipeline parallelism (pp_size={plugin.pp_size}) cannot yet be "
+            f"pipeline parallelism (pp_size={pp}) cannot yet be "
             f"combined with {offending}; use pp with dp/fsdp only"
         )
-    if plugin.num_micro_batches < plugin.pp_size:
+    if plugin.num_micro_batches < pp:
         raise ValueError(
             f"num_micro_batches ({plugin.num_micro_batches}) must be >= "
-            f"pp_size ({plugin.pp_size}) or the pipeline bubbles dominate"
+            f"pp_size ({pp}) or the pipeline bubbles dominate"
         )
 
 

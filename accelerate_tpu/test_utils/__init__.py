@@ -65,9 +65,17 @@ def are_the_same_tensors(tensor: Any) -> bool:
 
 def execute_subprocess_async(cmd: list[str], env=None, timeout=600) -> str:
     """Run a child process, raising with its output on failure
-    (reference :544)."""
+    (reference :544). The package root is injected into ``PYTHONPATH`` so
+    children can import ``accelerate_tpu`` without a pip install."""
+    child_env = dict(env or os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    existing = child_env.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        child_env["PYTHONPATH"] = (
+            pkg_root + (os.pathsep + existing if existing else "")
+        )
     result = subprocess.run(
-        cmd, env=env or os.environ.copy(), capture_output=True, text=True,
+        cmd, env=child_env, capture_output=True, text=True,
         timeout=timeout,
     )
     if result.returncode != 0:

@@ -11,6 +11,18 @@ test`.
 
 from __future__ import annotations
 
+import os
+import sys
+
+# When run by file path (`python .../test_script.py`) without the package
+# pip-installed, the package root is not on sys.path; bootstrap it so the
+# script works from any cwd (reference scripts rely on an installed package).
+_PKG_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "..")
+)
+if _PKG_ROOT not in sys.path:
+    sys.path.insert(0, _PKG_ROOT)
+
 import jax
 import jax.numpy as jnp
 import numpy as np

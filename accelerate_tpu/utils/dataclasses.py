@@ -292,10 +292,11 @@ class ParallelismPlugin(KwargsHandler):
         env = os.environ.get(ENV_PREFIX + "SHARDING_STRATEGY")
         if env is not None and self.sharding_strategy == defaults["sharding_strategy"]:
             self.sharding_strategy = ShardingStrategy(env)
-        sizes = [self.dp_size, self.fsdp_size, self.tp_size, self.sp_size, self.ep_size]
+        sizes = [self.dp_size, self.pp_size, self.fsdp_size, self.tp_size,
+                 self.sp_size, self.ep_size]
         if sizes.count(-1) > 1:
             raise ValueError("at most one mesh axis may be -1 (auto)")
-        for s in sizes + [self.pp_size]:
+        for s in sizes:
             if s == 0 or s < -1:
                 raise ValueError(f"invalid mesh degree {s}")
 

@@ -42,8 +42,10 @@ def offload_weight(
     return entry
 
 
-def load_offloaded_weight(weight_file: str, weight_info: dict) -> np.ndarray:
-    """Read one tensor back (reference :46)."""
+def open_offloaded_weight(weight_file: str, weight_info: dict) -> np.ndarray:
+    """Open one tensor as a read-only memmap WITHOUT copying: slicing the
+    result reads only the touched bytes from disk — the primitive the
+    streamed-execution path (big_modeling.streamed_apply) builds on."""
     shape = tuple(weight_info["shape"]) or (1,)
     dtype = weight_info["dtype"]
     np_dtype = np.int16 if dtype == "bfloat16" else np.dtype(dtype)
@@ -51,8 +53,13 @@ def load_offloaded_weight(weight_file: str, weight_info: dict) -> np.ndarray:
     if dtype == "bfloat16":
         import jax.numpy as jnp
 
-        return np.asarray(arr).view(jnp.bfloat16.dtype)
-    return np.asarray(arr)
+        arr = arr.view(jnp.bfloat16.dtype)
+    return arr
+
+
+def load_offloaded_weight(weight_file: str, weight_info: dict) -> np.ndarray:
+    """Read one tensor back fully into RAM (reference :46)."""
+    return np.asarray(open_offloaded_weight(weight_file, weight_info))
 
 
 def save_offload_index(index: dict, offload_folder: str) -> None:
@@ -104,6 +111,16 @@ class OffloadedWeightsLoader(Mapping):
             self.save_folder, f"{_safe_filename(key)}.dat"
         )
         return load_offloaded_weight(weight_file, weight_info)
+
+    def get_memmap(self, key: str) -> np.ndarray:
+        """Zero-copy view of one tensor; slices read lazily from disk."""
+        if key in self.state_dict:
+            return np.asarray(self.state_dict[key])
+        weight_info = self.index[key]
+        weight_file = os.path.join(
+            self.save_folder, f"{_safe_filename(key)}.dat"
+        )
+        return open_offloaded_weight(weight_file, weight_info)
 
     def __iter__(self):
         return iter(self.all_keys)

@@ -7,6 +7,7 @@ import pytest
 from accelerate_tpu.launchers import debug_launcher, notebook_launcher
 from accelerate_tpu.test_utils.scripts.multiprocess_worker import (
     collective_worker,
+    sharded_checkpoint_worker,
     training_worker,
 )
 
@@ -24,3 +25,8 @@ def test_debug_launcher_collectives():
 @pytest.mark.slow
 def test_debug_launcher_training():
     debug_launcher(training_worker, num_processes=2)
+
+
+@pytest.mark.slow
+def test_debug_launcher_sharded_checkpoint(tmp_path):
+    debug_launcher(sharded_checkpoint_worker, (str(tmp_path),), num_processes=2)

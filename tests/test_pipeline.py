@@ -156,3 +156,15 @@ def test_pipeline_plugin_validation():
         validate_pipeline_plugin(
             ParallelismPlugin(pp_size=4, num_micro_batches=2)
         )
+
+
+def test_auto_pp_size_still_validated():
+    """pp_size=-1 resolving to >1 must hit the same tp/sp/ep rejection as an
+    explicit pp_size (review finding: -1 skipped validation entirely)."""
+    from accelerate_tpu.parallel import build_mesh
+
+    with pytest.raises(NotImplementedError, match="pipeline parallelism"):
+        build_mesh(
+            ParallelismPlugin(dp_size=2, pp_size=-1, tp_size=2,
+                              num_micro_batches=4)
+        )

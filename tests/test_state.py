@@ -41,14 +41,18 @@ def test_split_between_processes_single():
 
 def test_accelerator_state_mesh_default_dp():
     state = AcceleratorState()
-    assert dict(state.mesh.shape) == {"dp": 8, "fsdp": 1, "ep": 1, "sp": 1, "tp": 1}
+    assert dict(state.mesh.shape) == {
+        "dp": 8, "pp": 1, "fsdp": 1, "ep": 1, "sp": 1, "tp": 1,
+    }
     assert state.data_parallel_size == 8
 
 
 def test_accelerator_state_mesh_hybrid():
     plugin = ParallelismPlugin(dp_size=-1, fsdp_size=2, tp_size=2)
     state = AcceleratorState(parallelism_plugin=plugin)
-    assert dict(state.mesh.shape) == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+    assert dict(state.mesh.shape) == {
+        "dp": 2, "pp": 1, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2,
+    }
     assert state.data_parallel_size == 4  # dp * fsdp
 
 

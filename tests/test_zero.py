@@ -104,3 +104,35 @@ def test_zero_trains_equivalently_to_dp(strategy):
         jax.tree.leaves(carry_dp["params"]), jax.tree.leaves(carry_z["params"])
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_zero1_step_built_before_init_carry():
+    """Building unified_step before init_carry must still pin ZeRO-1 opt
+    shardings (review finding: build-time capture silently disabled it)."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    plugin = ParallelismPlugin(
+        fsdp_size=8, sharding_strategy=ShardingStrategy.SHARD_OPT,
+        min_weight_size=8,
+    )
+    acc = Accelerator(parallelism_plugin=plugin)
+    params = acc.prepare(_params())
+    opt = acc.prepare(optax.adam(1e-2))
+    step = acc.unified_step(_loss)  # built FIRST: opt state not created yet
+    carry = acc.init_carry(params, opt)
+    batch = {
+        "x": jnp.ones((8, 16), jnp.float32),
+        "y": jnp.zeros((8, 8), jnp.float32),
+    }
+    carry, _ = step(carry, batch)
+    moment_specs = [
+        tuple(l.sharding.spec)
+        for l in jax.tree.leaves(carry["opt_state"])
+        if getattr(l, "ndim", 0) >= 2
+    ]
+    assert moment_specs
+    for spec in moment_specs:
+        assert any(s == "fsdp" for s in spec), spec
