@@ -8,6 +8,7 @@ __version__ = "0.1.0"
 
 from .accelerator import Accelerator
 from .big_modeling import (
+    OffloadedLeaf,
     cpu_offload,
     disk_offload,
     dispatch_params,
@@ -15,9 +16,12 @@ from .big_modeling import (
     init_empty_weights,
     init_on_device,
     load_checkpoint_and_dispatch,
+    materialize_offloaded,
+    streamed_apply,
 )
 from .data_loader import DataLoader, prepare_data_loader, skip_first_batches
 from .launchers import debug_launcher, notebook_launcher
+from .local_sgd import LocalSGD
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler
@@ -35,6 +39,9 @@ from .utils import (
 from .utils.memory import find_executable_batch_size
 
 __all__ = [
+    "OffloadedLeaf",
+    "materialize_offloaded",
+    "streamed_apply",
     "cpu_offload",
     "disk_offload",
     "dispatch_params",
@@ -44,6 +51,7 @@ __all__ = [
     "load_checkpoint_and_dispatch",
     "debug_launcher",
     "notebook_launcher",
+    "LocalSGD",
     "find_executable_batch_size",
     "Accelerator",
     "AcceleratedOptimizer",

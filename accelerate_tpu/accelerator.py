@@ -103,6 +103,7 @@ class Accelerator:
         rng_types: Optional[list[str]] = None,
         seed: int = 0,
         mixed_precision_policy: Optional[MixedPrecisionPolicy] = None,
+        profile_kwargs=None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(
             project_dir=project_dir
@@ -143,6 +144,9 @@ class Accelerator:
             [log_with] if isinstance(log_with, str) else (log_with or [])
         )
         self.init_handler = None
+        # ProfileKwargs handler (reference kwargs_handlers ProfileKwargs);
+        # None -> accelerator.profile() is a no-op unless given a dir
+        self.profile_handler = profile_kwargs
 
     # ------------------------------------------------------------------ #
     # topology passthroughs (reference accelerator.py properties)
@@ -665,6 +669,21 @@ class Accelerator:
         """Reference :3323. JAX has no ambient autocast; the compute-dtype
         cast happens in the step. Kept as a no-op context for porting."""
         yield
+
+    @contextmanager
+    def profile(self, profile_dir: Optional[str] = None, profile_kwargs=None):
+        """Capture an XLA profiler trace of the enclosed steps (the
+        reference's ``accelerator.profile`` torch.profiler context,
+        re-targeted to ``jax.profiler`` — see utils/profiling.py). View in
+        TensorBoard's Profile tab (MXU utilization, per-op HBM traffic).
+        No-op when no directory is configured, so it can wrap the loop
+        unconditionally."""
+        from .utils.profiling import profile as _profile
+
+        if profile_dir is None and profile_kwargs is None:
+            profile_kwargs = self.profile_handler
+        with _profile(profile_dir, profile_kwargs) as p:
+            yield p
 
     # ------------------------------------------------------------------ #
     # collectives / metrics

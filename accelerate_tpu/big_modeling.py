@@ -226,6 +226,122 @@ def check_device_map(params: Any, device_map: dict) -> None:
 
 
 # ---------------------------------------------------------------------- #
+# lazy disk-tier handles — the executable AlignDevicesHook capability
+# (reference hooks.py:219: offloaded modules still *run*)
+# ---------------------------------------------------------------------- #
+class OffloadedLeaf:
+    """Lazy stand-in for one disk-offloaded tensor in a param tree.
+
+    Unknown to jax.tree, so it traverses as a leaf. ``load()`` reads the
+    whole tensor; ``memmap()`` returns a zero-copy view whose slices read
+    only the touched bytes — the primitive :func:`streamed_apply` uses to
+    bound HBM *and* host RAM to one layer group at a time.
+    """
+
+    __slots__ = ("name", "loader", "shape", "dtype")
+
+    def __init__(self, name: str, loader, shape, dtype):
+        self.name = name
+        self.loader = loader
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+
+    def load(self) -> np.ndarray:
+        return self.loader[self.name]
+
+    def memmap(self) -> np.ndarray:
+        return self.loader.get_memmap(self.name)
+
+    def __repr__(self):
+        return f"OffloadedLeaf({self.name!r}, {self.shape}, {self.dtype})"
+
+
+def materialize_offloaded(tree: Any, device: Optional[jax.Device] = None) -> Any:
+    """Replace every :class:`OffloadedLeaf` with a live device array.
+
+    Peak HBM is the full tree — use :func:`streamed_apply` for models whose
+    offloaded portion exceeds HBM. Other leaves pass through untouched.
+    """
+    def _one(leaf):
+        if isinstance(leaf, OffloadedLeaf):
+            arr = leaf.load()
+            return (
+                jax.device_put(arr, device) if device is not None
+                else jnp.asarray(arr)
+            )
+        return leaf
+
+    return jax.tree.map(
+        _one, tree, is_leaf=lambda x: isinstance(x, OffloadedLeaf)
+    )
+
+
+def streamed_apply(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    group_size: int = 1,
+    device: Optional[jax.Device] = None,
+) -> jax.Array:
+    """Run a stacked-layer model whose weights (partly) live on disk,
+    streaming ``group_size`` layers into HBM at a time.
+
+    The TPU redesign of the reference's per-module hook swapping
+    (hooks.py:219 AlignDevicesHook + utils/offload.py memmaps): our models
+    stack layers on a leading dim (the ``nn.scan`` layout), so "offloaded
+    execution" is a host loop over layer groups — slice the group from the
+    memmap (reads only those bytes), device_put, apply, drop. Peak HBM =
+    activations + one group of layers.
+
+    ``block_fn(group_params, x) -> x`` applies a group (leaves carry a
+    leading dim of ``<= group_size``). Leaves already in HBM are sliced on
+    device.
+    """
+    leaves = jax.tree.leaves(
+        stacked_params, is_leaf=lambda l: isinstance(l, OffloadedLeaf)
+    )
+    if not leaves:
+        raise ValueError("empty parameter tree")
+    for leaf in leaves:
+        if len(getattr(leaf, "shape", ())) < 1:
+            raise ValueError(
+                "streamed_apply requires every leaf to carry a leading "
+                f"stacked-layer dim; got a 0-dim leaf {leaf!r} — stack "
+                "scalars to shape (num_layers,) or exclude them"
+            )
+    num_layers = leaves[0].shape[0]
+    for leaf in leaves:
+        if leaf.shape[0] != num_layers:
+            raise ValueError(
+                "streamed_apply requires every leaf to carry the stacked "
+                f"layer dim; got leading dims {num_layers} vs {leaf.shape[0]}"
+            )
+
+    def _slice_group(leaf, lo, hi):
+        if isinstance(leaf, OffloadedLeaf):
+            piece = np.asarray(leaf.memmap()[lo:hi])  # reads only [lo:hi)
+            return (
+                jax.device_put(piece, device)
+                if device is not None else jnp.asarray(piece)
+            )
+        return leaf[lo:hi]
+
+    for lo in range(0, num_layers, group_size):
+        hi = min(lo + group_size, num_layers)
+        group = jax.tree.map(
+            lambda l: _slice_group(l, lo, hi),
+            stacked_params,
+            is_leaf=lambda l: isinstance(l, OffloadedLeaf),
+        )
+        x = block_fn(group, x)
+        # drop the group's device buffers before the next load
+        for leaf in jax.tree.leaves(group):
+            if isinstance(leaf, jax.Array):
+                leaf.delete()
+    return x
+
+
+# ---------------------------------------------------------------------- #
 # dispatch — reference big_modeling.py:305
 # ---------------------------------------------------------------------- #
 def _host_sharding(device: jax.Device):
@@ -246,8 +362,9 @@ def dispatch_params(
     """Place each param-tree group per ``device_map``: a device index puts
     the group on that chip; "cpu" pins it in host RAM (XLA streams it in on
     use when the platform supports pinned_host, else keeps numpy); "disk"
-    writes a memmap and returns a lazy handle (reference dispatch_model +
-    OffloadedWeightsLoader)."""
+    writes a memmap and returns a lazy :class:`OffloadedLeaf` handle that
+    :func:`materialize_offloaded` / :func:`streamed_apply` can execute
+    (reference dispatch_model + OffloadedWeightsLoader)."""
     check_device_map(params, device_map)
     devices = jax.local_devices()
     named = flatten_tree(params)
@@ -263,7 +380,7 @@ def dispatch_params(
             offload_index[name] = offload_weight(
                 np.asarray(leaf), name, offload_dir
             )
-            placed[name] = None
+            placed[name] = None  # replaced with an OffloadedLeaf below
         elif target == "cpu":
             host = _host_sharding(devices[0])
             arr = np.asarray(leaf)
@@ -274,10 +391,15 @@ def dispatch_params(
         else:
             placed[name] = jax.device_put(leaf, devices[int(target)])
     if offload_index:
-        from .utils.offload import save_offload_index
+        from .utils.offload import OffloadedWeightsLoader, save_offload_index
 
         save_offload_index(offload_index, offload_dir)
-    # rebuild the tree, substituting OffloadedWeightsLoader handles for disk
+        loader = OffloadedWeightsLoader(save_folder=offload_dir)
+        for name, entry in offload_index.items():
+            placed[name] = OffloadedLeaf(
+                name, loader, entry["shape"], entry["dtype"]
+            )
+    # rebuild the tree, substituting OffloadedLeaf handles for disk
     treedef = jax.tree_util.tree_structure(
         params, is_leaf=lambda x: not isinstance(x, dict)
     )

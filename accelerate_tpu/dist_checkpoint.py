@@ -64,11 +64,17 @@ def save_sharded_tree(
 
     Every process must call this (it is collective only through the
     filesystem); each writes its own pair of files. Leaves that are not
-    jax.Arrays (host numpy/python scalars) are owned by process 0.
+    globally-sharded jax.Arrays (host numpy, python scalars, and — in a
+    multi-process run — process-local fully-addressable arrays, whose value
+    may differ per process) are owned by process 0: rank 0's copy wins,
+    matching the legacy rank-0 writer. Without this gate every process
+    would write an identical chunk for the same region and restore would
+    see overlapping coverage.
     """
     from .checkpointing import flatten_tree
 
     proc = jax.process_index() if process_index is None else process_index
+    world = jax.process_count()
     os.makedirs(output_dir, exist_ok=True)
     named = flatten_tree(tree)
 
@@ -76,7 +82,11 @@ def save_sharded_tree(
     manifest: dict[str, dict] = {}
     fname = SHARD_FILE_PATTERN.format(proc)
     for key, leaf in named.items():
-        if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+        if (
+            isinstance(leaf, jax.Array)
+            and hasattr(leaf, "addressable_shards")
+            and (world == 1 or not leaf.is_fully_addressable)
+        ):
             shape = leaf.shape
             dtype = str(leaf.dtype)
             chunks = []
@@ -266,12 +276,18 @@ def load_sharded_tree(
     fp16 run whose carry grew a ``loss_scale``) — the legacy single-file
     loader's merge semantics.
     """
-    from .checkpointing import _path_str
-
     manifest = _merged_manifest(input_dir)
-    files = _FileCache(input_dir)
     paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
+    with _FileCache(input_dir) as files:
+        return _load_leaves(
+            manifest, paths_and_leaves, treedef, leaves, files, strict
+        )
+
+
+def _load_leaves(manifest, paths_and_leaves, treedef, leaves, files, strict):
+    from .checkpointing import _path_str
+
     for path, tleaf in paths_and_leaves:
         key = _path_str(path)
         if key not in manifest:
@@ -309,8 +325,6 @@ def load_sharded_tree(
             ).reshape(t_shape)
             value = jnp.asarray(_cast(full))
         leaves.append(value)
-    result = jax.tree_util.tree_unflatten(treedef, leaves)
     # make_array_from_callback runs its callbacks eagerly, so every read
-    # has happened by now and the handles can be closed.
-    files.close()
-    return result
+    # has happened by the time the _FileCache context closes the handles.
+    return jax.tree_util.tree_unflatten(treedef, leaves)

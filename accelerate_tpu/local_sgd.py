@@ -1,0 +1,189 @@
+"""Local SGD: train replicas independently, average parameters every K steps.
+
+Parity: reference ``local_sgd.py:19-102`` — ``LocalSGD(accelerator, model,
+local_sgd_steps, enabled)`` wraps the training loop, suppresses the DDP
+gradient all-reduce inside the window (``no_sync``) and calls a manual
+parameter ``all_reduce`` mean every ``local_sgd_steps`` steps.
+
+TPU-native redesign: under GSPMD there is no grad-hook to suppress — cross-
+replica sync is implied by array shardings. Independent local training is
+expressed in one of two ways:
+
+* **multi-process** (one trainer per host, the reference's setting): keep
+  params host-local (not globally sharded); each process steps its own
+  copy, and :meth:`LocalSGD.step` performs the periodic cross-process
+  parameter mean (``utils.operations.reduce``) — exactly the reference's
+  ``_sync_and_avg_model_params``.
+* **single-process SPMD**: give each data-parallel group its own weights by
+  stacking params on a leading ``dp``-sharded replica dim
+  (:func:`replicate_params`) and training with a vmapped loss; the periodic
+  :func:`average_replicas` mean collapses the stacked dim — XLA lowers it
+  to an all-reduce over the ``dp`` axis of the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class LocalSGD:
+    """Context manager for periodic parameter averaging (reference :19).
+
+    Usage (multi-process)::
+
+        with LocalSGD(accelerator, local_sgd_steps=8) as lsgd:
+            for batch in loader:
+                carry, _ = step(carry, batch)
+                carry = lsgd.step(carry)
+
+    ``step`` must be called once per optimizer step with either the train
+    carry (its ``"params"`` — and, so stale moments do not undo the
+    averaging, ``"opt_state"`` — are averaged) or a bare param tree; it
+    returns the same structure, averaged on sync steps. On ``__exit__`` a
+    final average runs unless the step count already landed on a boundary
+    (reference :78 syncs on leaving the context).
+    """
+
+    def __init__(
+        self,
+        accelerator,
+        local_sgd_steps: int = 8,
+        enabled: bool = True,
+    ):
+        if local_sgd_steps < 1:
+            raise ValueError(f"local_sgd_steps must be >= 1, got {local_sgd_steps}")
+        self.accelerator = accelerator
+        self.local_sgd_steps = local_sgd_steps
+        self.enabled = enabled
+        self.num_steps = 0
+        self._last_tree: Any = None
+
+    def __enter__(self) -> "LocalSGD":
+        self.num_steps = 0
+        if self.enabled and self.accelerator.num_processes == 1:
+            logger.debug(
+                "LocalSGD on a single process averages over the in-process "
+                "replica dim only (see replicate_params)"
+            )
+        return self
+
+    def __exit__(self, *exc):
+        # final flush so replicas agree when the loop length is not a
+        # multiple of local_sgd_steps (reference :78). Only dict carries can
+        # be updated in place; any other container must be flushed by the
+        # caller (``carry = lsgd.flush(carry)``) — warn instead of silently
+        # leaving replicas diverged.
+        if (
+            self.enabled
+            and exc[0] is None
+            and self._last_tree is not None
+            and self.num_steps % self.local_sgd_steps != 0
+        ):
+            if isinstance(self._last_tree, dict):
+                logger.debug("LocalSGD: final parameter average on exit")
+                averaged = self._average(self._last_tree)
+                _copy_into(self._last_tree, averaged)
+            else:
+                logger.warning(
+                    "LocalSGD exited mid-window with a non-dict tree; the "
+                    "exit flush cannot update it in place — call "
+                    "`tree = local_sgd.flush(tree)` before leaving the "
+                    "context or replicas stay diverged."
+                )
+        return False
+
+    def step(self, tree: Any) -> Any:
+        """Advance the step counter; every ``local_sgd_steps``-th call
+        returns the cross-replica parameter average of ``tree``."""
+        if not self.enabled:
+            return tree
+        self.num_steps += 1
+        self._last_tree = tree
+        if self.num_steps % self.local_sgd_steps != 0:
+            return tree
+        out = self._average(tree)
+        self._last_tree = out
+        return out
+
+    def flush(self, tree: Any) -> Any:
+        """Force an average now regardless of the window position — returns
+        the synced tree (use before leaving the context with non-dict
+        trees, or at eval boundaries)."""
+        if not self.enabled:
+            return tree
+        out = self._average(tree)
+        self._last_tree = out
+        self.num_steps = 0
+        return out
+
+    def _average(self, tree: Any) -> Any:
+        from .utils.operations import reduce
+
+        if isinstance(tree, dict) and "params" in tree:
+            out = dict(tree)
+            out["params"] = reduce(tree["params"], "mean")
+            if "opt_state" in tree:
+                out["opt_state"] = _average_float_leaves(tree["opt_state"])
+            return out
+        return reduce(tree, "mean")
+
+
+def _average_float_leaves(tree: Any) -> Any:
+    """Cross-process mean of floating leaves only (Adam moments); integer
+    leaves (step counts) pass through untouched."""
+    from .utils.operations import reduce
+
+    return jax.tree.map(
+        lambda x: reduce(x, "mean")
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )
+
+
+def _copy_into(dst: Any, src: Any) -> None:
+    """Best-effort in-place update for the exit-flush (dict carries)."""
+    if isinstance(dst, dict) and isinstance(src, dict):
+        for k in src:
+            dst[k] = src[k]
+
+
+# ---------------------------------------------------------------------- #
+# single-process SPMD expression: a dp-sharded replica dim
+# ---------------------------------------------------------------------- #
+def replicate_params(
+    params: Any, mesh, num_replicas: Optional[int] = None
+) -> Any:
+    """Stack ``num_replicas`` copies of ``params`` on a new leading dim
+    sharded over the ``dp`` mesh axis: each data-parallel group now owns an
+    *independent* copy (train it with a vmapped loss), which is the SPMD
+    form of "no gradient sync"."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .utils.constants import MESH_AXIS_DATA
+
+    n = num_replicas or mesh.shape[MESH_AXIS_DATA]
+    if n % mesh.shape[MESH_AXIS_DATA]:
+        raise ValueError(
+            f"num_replicas {n} must be a multiple of dp={mesh.shape[MESH_AXIS_DATA]}"
+        )
+
+    def _one(leaf):
+        stacked = jnp.broadcast_to(leaf[None], (n,) + leaf.shape)
+        spec = P(MESH_AXIS_DATA, *([None] * leaf.ndim))
+        return jax.device_put(stacked, NamedSharding(mesh, spec))
+
+    return jax.tree.map(_one, params)
+
+
+def average_replicas(params: Any) -> Any:
+    """Collapse the leading replica dim by mean — lowered by XLA to an
+    all-reduce over the ``dp`` axis when the dim is dp-sharded."""
+    return jax.tree.map(lambda leaf: jnp.mean(leaf, axis=0), params)

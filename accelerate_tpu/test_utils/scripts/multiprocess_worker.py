@@ -88,3 +88,33 @@ def sharded_checkpoint_worker(tmpdir):
     restored = load_sharded_tree(template, tmpdir)
     for shard in restored["k"].addressable_shards:
         np.testing.assert_array_equal(np.asarray(shard.data), full[shard.index])
+
+
+def local_sgd_worker():
+    """Each process trains its own copy toward a different target with NO
+    gradient sync; LocalSGD's periodic average must land all processes on
+    the mean (reference local_sgd.py semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.local_sgd import LocalSGD
+
+    acc = Accelerator()
+    assert acc.num_processes == 2
+    target = float(acc.process_index)  # rank0 -> 0, rank1 -> 1
+    params = {"w": jnp.asarray(5.0)}
+
+    @jax.jit
+    def step(p):
+        g = jax.grad(lambda w: (w - target) ** 2)(p["w"])
+        return {"w": p["w"] - 0.25 * g}
+
+    with LocalSGD(acc, local_sgd_steps=4) as lsgd:
+        for i in range(8):
+            params = step(params)
+            params = lsgd.step(params)
+    # after the final sync boundary every process holds the cross-process
+    # mean; both ranks converged near their own target -> mean ~ 0.5
+    w = float(np.asarray(params["w"]))
+    assert abs(w - 0.5) < 0.05, w

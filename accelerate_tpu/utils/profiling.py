@@ -1,0 +1,291 @@
+"""Profiling & measurement subsystem (SURVEY §5.1).
+
+Parity: reference ``benchmarks/measures_util.py`` (start/end_measure wall
+time + CPU RSS + per-GPU peak memory, peak-CPU monitor thread) and the
+peak-memory CI gates (``test_utils/scripts/external_deps/
+test_peak_memory_usage.py``). TPU-native additions: the XLA profiler
+(``jax.profiler.trace`` -> TensorBoard/perfetto traces, the tool that shows
+MXU utilization and HBM traffic per op) is exposed as a first-class
+``Accelerator.profile()`` context, and step timing understands async
+dispatch (a step is only *done* at ``block_until_ready``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gc
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+
+# ---------------------------------------------------------------------- #
+# device / host memory probes
+# ---------------------------------------------------------------------- #
+def device_memory_stats(device: Optional[jax.Device] = None) -> dict[str, int]:
+    """Live/peak HBM bytes for one device. Keys: ``bytes_in_use``,
+    ``peak_bytes_in_use``, ``bytes_limit`` (0 when the backend does not
+    report, e.g. CPU)."""
+    device = device or jax.local_devices()[0]
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        stats = {}
+    return {
+        "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+        "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+        "bytes_limit": int(stats.get("bytes_limit", 0)),
+    }
+
+
+def host_memory_rss() -> int:
+    """Current process RSS in bytes (no psutil dependency)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        import resource
+
+        # ru_maxrss is KiB on Linux (peak, not current — best effort)
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+class PeakHostMemory:
+    """Background sampler for peak host RSS (reference PeakCPUMemory:22 —
+    same busy-poll design: sleeping misses the peak)."""
+
+    def __init__(self):
+        self._monitoring = False
+        self._peak = -1
+        self._thread: Optional[threading.Thread] = None
+
+    def _monitor(self):
+        self._peak = -1
+        while True:
+            self._peak = max(self._peak, host_memory_rss())
+            if not self._monitoring:
+                break
+
+    def start(self):
+        self._monitoring = True
+        self._thread = threading.Thread(target=self._monitor, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> int:
+        self._monitoring = False
+        if self._thread is not None:
+            self._thread.join()
+        return self._peak
+
+
+def start_measure() -> dict[str, Any]:
+    """Snapshot wall time + host RSS + per-device HBM (reference
+    ``start_measure`` benchmarks/measures_util.py:52)."""
+    gc.collect()
+    measures: dict[str, Any] = {"time": time.perf_counter()}
+    measures["host"] = host_memory_rss()
+    for i, d in enumerate(jax.local_devices()):
+        measures[f"device:{i}"] = device_memory_stats(d)["bytes_in_use"]
+    _peak_tracker.start()
+    return measures
+
+
+def end_measure(start: dict[str, Any]) -> dict[str, Any]:
+    """Deltas since :func:`start_measure` (reference ``end_measure``:68):
+    seconds elapsed, host RSS delta + peak, per-device HBM delta + peak."""
+    out: dict[str, Any] = {"time": time.perf_counter() - start["time"]}
+    gc.collect()
+    out["host"] = host_memory_rss() - start["host"]
+    out["host-peak"] = max(0, _peak_tracker.stop() - start["host"])
+    for i, d in enumerate(jax.local_devices()):
+        stats = device_memory_stats(d)
+        out[f"device:{i}"] = stats["bytes_in_use"] - start[f"device:{i}"]
+        out[f"device:{i}-peak"] = stats["peak_bytes_in_use"]
+    return out
+
+
+def log_measures(measures: dict[str, Any], description: str = "run") -> None:
+    """Human-readable dump (reference ``log_measures``:86)."""
+    print(f"{description}:")
+    print(f"- Time: {measures['time']:.2f}s")
+    for key, value in measures.items():
+        if key.startswith(("device", "host")):
+            print(f"- {key}: {value >> 20} MiB")
+
+
+_peak_tracker = PeakHostMemory()
+
+
+# ---------------------------------------------------------------------- #
+# step timing (async-dispatch aware)
+# ---------------------------------------------------------------------- #
+class StepTimer:
+    """Wall-clock timer for compiled steps.
+
+    JAX dispatch is asynchronous: ``step(carry, batch)`` returns before the
+    TPU finishes, so naive timing measures Python overhead. ``tick``
+    blocks on the result it is handed, charging the full device time to
+    the step. First ``skip`` ticks (compile) are excluded from stats.
+    """
+
+    def __init__(self, skip: int = 1):
+        self.skip = skip
+        self.times: list[float] = []
+        self._count = 0
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+    def tick(self, result: Any = None) -> float:
+        """Mark one step done (blocking on ``result`` if given); returns
+        the step's seconds."""
+        if result is not None:
+            jax.block_until_ready(result)
+        now = time.perf_counter()
+        dt = now - self._t0 if self._t0 is not None else 0.0
+        self._t0 = now
+        self._count += 1
+        if self._count > self.skip:
+            self.times.append(dt)
+        return dt
+
+    def summary(self) -> dict[str, float]:
+        if not self.times:
+            return {"steps": 0}
+        arr = np.asarray(self.times)
+        return {
+            "steps": len(arr),
+            "mean_s": float(arr.mean()),
+            "median_s": float(np.median(arr)),
+            "p90_s": float(np.percentile(arr, 90)),
+            "min_s": float(arr.min()),
+            "total_s": float(arr.sum()),
+        }
+
+
+# ---------------------------------------------------------------------- #
+# the XLA profiler
+# ---------------------------------------------------------------------- #
+@dataclass
+class ProfileKwargs:
+    """Configuration for :meth:`Accelerator.profile` (the reference's
+    ``ProfileKwargs`` handler shape, re-targeted from torch.profiler to
+    ``jax.profiler``).
+
+    ``output_trace_dir``: where the TensorBoard/perfetto trace goes. When
+    None, profiling is a no-op (so ``accelerator.profile()`` can stay in
+    the loop unconditionally). ``skip_first``: un-profiled warmup steps
+    (compile steps drown the timeline otherwise) — requires the loop to
+    call :meth:`ProfileHandle.step` once per step so the handle knows when
+    the warmup is over.
+    """
+
+    output_trace_dir: Optional[str] = None
+    skip_first: int = 0
+    # jax.profiler options (host_tracer_level 2 adds python annotations)
+    host_tracer_level: int = 2
+    python_tracer_level: int = 0
+    create_perfetto_link: bool = False
+
+    def to_handler(self):
+        return self
+
+
+def _start_trace_kwargs(kw: ProfileKwargs) -> dict:
+    """Only pass options the running jax version supports (the kwarg set
+    changed across versions; detect from the signature, never by try/except
+    around user code)."""
+    import inspect
+
+    params = inspect.signature(jax.profiler.start_trace).parameters
+    out: dict[str, Any] = {}
+    if "create_perfetto_link" in params:
+        out["create_perfetto_link"] = kw.create_perfetto_link
+    if "profiler_options" in params and hasattr(jax.profiler, "ProfileOptions"):
+        try:
+            opts = jax.profiler.ProfileOptions()
+            opts.host_tracer_level = kw.host_tracer_level
+            opts.python_tracer_level = kw.python_tracer_level
+            out["profiler_options"] = opts
+        except Exception:
+            pass
+    return out
+
+
+class ProfileHandle:
+    """A live profiling session. ``dir`` is the trace directory. With
+    ``skip_first > 0`` the trace starts lazily at the ``skip_first``-th
+    :meth:`step` call; otherwise it is already running on entry."""
+
+    def __init__(self, target: str, kw: ProfileKwargs):
+        self.dir = target
+        self._kw = kw
+        self._started = False
+        self._stopped = False
+        self._steps = 0
+
+    def _start(self):
+        if self._started:
+            return
+        logger.info(f"XLA profiler trace -> {self.dir}")
+        jax.profiler.start_trace(self.dir, **_start_trace_kwargs(self._kw))
+        self._started = True
+
+    def step(self):
+        """Mark one training step done (only needed with ``skip_first``)."""
+        self._steps += 1
+        if not self._started and self._steps >= self._kw.skip_first:
+            self._start()
+
+    def _stop(self):
+        if self._started and not self._stopped:
+            jax.profiler.stop_trace()
+        self._stopped = True
+
+
+@contextlib.contextmanager
+def profile(
+    output_trace_dir: Optional[str] = None,
+    kwargs: Optional[ProfileKwargs] = None,
+):
+    """Capture an XLA profiler trace around the enclosed steps; yields a
+    :class:`ProfileHandle` (or None when no directory is configured).
+
+    View with TensorBoard (`tensorboard --logdir <dir>`; the Profile tab
+    shows per-op device time, MXU utilization and the HBM roofline) or the
+    perfetto link.
+    """
+    kw = kwargs or ProfileKwargs(output_trace_dir=output_trace_dir)
+    target = output_trace_dir or kw.output_trace_dir
+    if target is None:
+        yield None
+        return
+    os.makedirs(target, exist_ok=True)
+    handle = ProfileHandle(target, kw)
+    if kw.skip_first <= 0:
+        handle._start()
+    try:
+        yield handle
+    finally:
+        handle._stop()
+
+
+def annotate(name: str):
+    """Named region in the trace timeline (``jax.profiler.TraceAnnotation``)
+    — the torch.profiler ``record_function`` analogue."""
+    return jax.profiler.TraceAnnotation(name)

@@ -30,3 +30,12 @@ def test_debug_launcher_training():
 @pytest.mark.slow
 def test_debug_launcher_sharded_checkpoint(tmp_path):
     debug_launcher(sharded_checkpoint_worker, (str(tmp_path),), num_processes=2)
+
+
+@pytest.mark.slow
+def test_debug_launcher_local_sgd():
+    from accelerate_tpu.test_utils.scripts.multiprocess_worker import (
+        local_sgd_worker,
+    )
+
+    debug_launcher(local_sgd_worker, num_processes=2)

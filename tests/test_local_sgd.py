@@ -1,0 +1,86 @@
+"""Local SGD tests (reference tests/test_grad_sync.py local-sgd cases +
+local_sgd.py:19-102 semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.local_sgd import (
+    LocalSGD,
+    average_replicas,
+    replicate_params,
+)
+
+
+def test_local_sgd_step_counts_and_averages():
+    acc = Accelerator()
+    params = {"w": jnp.asarray(2.0)}
+    with LocalSGD(acc, local_sgd_steps=3) as lsgd:
+        for i in range(1, 7):
+            params = lsgd.step(params)
+            assert lsgd.num_steps == i
+    # single process: average is identity, but counters must have advanced
+    assert float(params["w"]) == 2.0
+
+
+def test_local_sgd_disabled_is_noop():
+    acc = Accelerator()
+    params = {"w": jnp.asarray(1.0)}
+    with LocalSGD(acc, local_sgd_steps=2, enabled=False) as lsgd:
+        out = lsgd.step(params)
+    assert lsgd.num_steps == 0
+    assert out is params
+
+
+def test_local_sgd_rejects_bad_steps():
+    acc = Accelerator()
+    with pytest.raises(ValueError):
+        LocalSGD(acc, local_sgd_steps=0)
+
+
+def test_replicated_independent_training_then_average():
+    """The SPMD form: dp groups train independent copies (no grad sync);
+    averaging collapses them to the mean — the local-SGD contract."""
+    acc = Accelerator()
+    mesh = acc.mesh
+    params = {"w": jnp.asarray(0.0)}
+    reps = replicate_params(params, mesh)
+    n = reps["w"].shape[0]
+    assert n == mesh.shape["dp"] == 8
+
+    # per-replica data: replica i regresses toward target i
+    targets = jnp.arange(float(n))
+
+    def per_replica_loss(w, t):
+        return (w - t) ** 2
+
+    @jax.jit
+    def step(reps):
+        grads = jax.vmap(jax.grad(per_replica_loss))(reps["w"], targets)
+        return {"w": reps["w"] - 0.25 * grads}
+
+    for _ in range(30):
+        reps = step(reps)
+    # replicas really diverged (trained on different data, no sync)
+    per_replica = np.asarray(reps["w"])
+    assert np.std(per_replica) > 1.0
+    np.testing.assert_allclose(per_replica, np.arange(n), atol=1e-3)
+
+    avg = average_replicas(reps)
+    np.testing.assert_allclose(
+        float(avg["w"]), float(np.mean(np.arange(n))), atol=1e-3
+    )
+
+
+def test_exit_flush_averages_leftover_steps():
+    """Leaving the context mid-window must still sync (reference :78)."""
+    acc = Accelerator()
+    carry = {"params": {"w": jnp.asarray(5.0)}}
+    with LocalSGD(acc, local_sgd_steps=4) as lsgd:
+        carry = lsgd.step(carry)  # 1 of 4 — window not complete
+    # single-process mean is identity; the contract here is that the flush
+    # ran without error and the carry still holds valid values
+    assert float(carry["params"]["w"]) == 5.0
+    assert lsgd.num_steps == 1

@@ -1,0 +1,117 @@
+"""Profiling subsystem tests (SURVEY §5.1; reference
+benchmarks/measures_util.py + ProfileKwargs handler shape)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.profiling import (
+    PeakHostMemory,
+    ProfileKwargs,
+    StepTimer,
+    annotate,
+    device_memory_stats,
+    end_measure,
+    host_memory_rss,
+    profile,
+    start_measure,
+)
+
+
+def test_measure_roundtrip():
+    start = start_measure()
+    x = jnp.ones((256, 256)) @ jnp.ones((256, 256))
+    jax.block_until_ready(x)
+    out = end_measure(start)
+    assert out["time"] > 0
+    assert "host" in out and "host-peak" in out
+    assert "device:0" in out
+
+
+def test_host_memory_rss_positive():
+    assert host_memory_rss() > 1 << 20  # a Python process is >1MiB
+
+
+def test_peak_host_memory_monitor():
+    tracker = PeakHostMemory()
+    tracker.start()
+    blob = np.ones((4 << 20,), np.uint8)  # 4MiB spike
+    peak = tracker.stop()
+    assert peak >= host_memory_rss() - (64 << 20)
+    del blob
+
+
+def test_device_memory_stats_shape():
+    stats = device_memory_stats()
+    assert set(stats) == {"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}
+
+
+def test_step_timer_skips_compile():
+    timer = StepTimer(skip=1)
+    with timer:
+        for i in range(4):
+            y = jnp.sin(jnp.ones((64,)) * i).sum()
+            timer.tick(y)
+    s = timer.summary()
+    assert s["steps"] == 3  # first (compile) tick excluded
+    assert s["mean_s"] >= 0 and s["p90_s"] >= s["median_s"] >= 0
+
+
+def test_profile_noop_without_dir():
+    with profile() as p:
+        assert p is None
+
+
+def test_profile_writes_trace(tmp_path):
+    target = str(tmp_path / "trace")
+    with profile(target) as p:
+        assert p.dir == target
+        with annotate("matmul-region"):
+            jax.block_until_ready(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+    # xplane trace files land under plugins/profile/<ts>/
+    found = glob.glob(os.path.join(target, "**", "*.xplane.pb"), recursive=True)
+    assert found, os.listdir(target)
+
+
+def test_profile_skip_first_defers_start(tmp_path):
+    target = str(tmp_path / "trace")
+    kw = ProfileKwargs(output_trace_dir=target, skip_first=2)
+    with profile(kwargs=kw) as p:
+        assert not p._started  # warmup: trace not yet running
+        p.step()
+        assert not p._started
+        p.step()  # skip_first-th step: trace starts here
+        assert p._started
+        jax.block_until_ready(jnp.ones((32, 32)) @ jnp.ones((32, 32)))
+    found = glob.glob(os.path.join(target, "**", "*.xplane.pb"), recursive=True)
+    assert found, os.listdir(target)
+
+
+def test_profile_user_error_propagates(tmp_path):
+    """A TypeError inside the profiled region must propagate unchanged
+    (review finding: the old fallback swallowed it and double-yielded)."""
+    with pytest.raises(TypeError, match="user bug"):
+        with profile(str(tmp_path / "t")):
+            raise TypeError("user bug")
+
+
+def test_accelerator_profile_context(tmp_path):
+    acc = Accelerator(
+        profile_kwargs=ProfileKwargs(output_trace_dir=str(tmp_path / "t"))
+    )
+    with acc.profile() as p:
+        jax.block_until_ready(jnp.ones((32, 32)) @ jnp.ones((32, 32)))
+    assert p.dir == str(tmp_path / "t")
+    assert os.path.isdir(p.dir)
+
+
+def test_accelerator_profile_noop_default():
+    acc = Accelerator()
+    with acc.profile() as p:
+        pass
+    assert p is None
