@@ -680,7 +680,10 @@ class Accelerator:
         unconditionally."""
         from .utils.profiling import profile as _profile
 
-        if profile_dir is None and profile_kwargs is None:
+        if profile_kwargs is None:
+            # the accelerator-level handler supplies options even when an
+            # explicit dir is passed (the dir argument wins over its
+            # output_trace_dir)
             profile_kwargs = self.profile_handler
         with _profile(profile_dir, profile_kwargs) as p:
             yield p

@@ -247,10 +247,13 @@ class OffloadedLeaf:
         self.dtype = jnp.dtype(dtype)
 
     def load(self) -> np.ndarray:
-        return self.loader[self.name]
+        # the .dat storage maps 0-dim tensors to shape (1,); restore the
+        # declared shape so materialization never changes the tree's shapes
+        return np.asarray(self.loader[self.name]).reshape(self.shape)
 
     def memmap(self) -> np.ndarray:
-        return self.loader.get_memmap(self.name)
+        arr = self.loader.get_memmap(self.name)
+        return arr.reshape(self.shape) if arr.shape != self.shape else arr
 
     def __repr__(self):
         return f"OffloadedLeaf({self.name!r}, {self.shape}, {self.dtype})"
@@ -297,6 +300,8 @@ def streamed_apply(
     leading dim of ``<= group_size``). Leaves already in HBM are sliced on
     device.
     """
+    if group_size < 1:
+        raise ValueError(f"group_size must be >= 1, got {group_size}")
     leaves = jax.tree.leaves(
         stacked_params, is_leaf=lambda l: isinstance(l, OffloadedLeaf)
     )

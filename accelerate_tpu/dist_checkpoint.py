@@ -278,16 +278,17 @@ def load_sharded_tree(
     """
     manifest = _merged_manifest(input_dir)
     paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
-    leaves = []
     with _FileCache(input_dir) as files:
-        return _load_leaves(
-            manifest, paths_and_leaves, treedef, leaves, files, strict
-        )
+        leaves = _load_leaves(manifest, paths_and_leaves, files, strict)
+    # make_array_from_callback runs its callbacks eagerly, so every read
+    # has happened by the time the _FileCache context closes the handles.
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def _load_leaves(manifest, paths_and_leaves, treedef, leaves, files, strict):
+def _load_leaves(manifest, paths_and_leaves, files, strict) -> list:
     from .checkpointing import _path_str
 
+    leaves = []
     for path, tleaf in paths_and_leaves:
         key = _path_str(path)
         if key not in manifest:
@@ -325,6 +326,4 @@ def _load_leaves(manifest, paths_and_leaves, treedef, leaves, files, strict):
             ).reshape(t_shape)
             value = jnp.asarray(_cast(full))
         leaves.append(value)
-    # make_array_from_callback runs its callbacks eagerly, so every read
-    # has happened by the time the _FileCache context closes the handles.
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+    return leaves

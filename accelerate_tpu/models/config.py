@@ -31,6 +31,11 @@ class TransformerConfig:
     # MoE (Mixtral family); 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # "capacity": sparse GShard-style dispatch (ops/moe.py) — FLOPs scale
+    # with K*capacity_factor, not E; "dense": every expert sees every token
+    # (the exact-math test oracle)
+    moe_dispatch: str = "capacity"
+    moe_capacity_factor: float = 2.0
     # remat: None | "full" | "dots" — trades FLOPs for HBM
     remat: Optional[str] = None
     # scan over layers: one compiled layer body, num_layers iterations —

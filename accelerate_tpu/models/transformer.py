@@ -191,18 +191,25 @@ class MLP(nn.Module):
 
 
 class MoE(nn.Module):
-    """Mixtral-style sparse MoE via dense one-hot dispatch.
+    """Mixtral-style sparse MoE.
 
     Expert weights are stacked on a leading ``expert`` logical axis; with
     ``ep_size > 1`` GSPMD shards experts across the ``ep`` mesh axis and the
-    dispatch/combine einsums lower to all-to-all — the expert-parallel
-    capability absent from the reference (SURVEY.md §2.4 EP row).
+    dispatch/combine lowers to all-to-all — the expert-parallel capability
+    absent from the reference (SURVEY.md §2.4 EP row).
+
+    Two dispatch modes (``config.moe_dispatch``): "capacity" — the
+    production GShard-style sparse schedule (ops/moe.py, FLOPs independent
+    of E); "dense" — every expert computes every token (O(E) FLOPs, exact
+    math, the test oracle).
     """
 
     config: TransformerConfig
 
     @nn.compact
     def __call__(self, x):
+        from ..ops.moe import load_balancing_loss, moe_dispatch_combine
+
         cfg = self.config
         dtype = _dtype(cfg)
         E, K = cfg.num_experts, cfg.num_experts_per_tok
@@ -220,12 +227,6 @@ class MoE(nn.Module):
         logits = router(x.astype(jnp.float32))  # (B,S,E)
         weights, sel = jax.lax.top_k(jax.nn.softmax(logits, -1), K)  # (B,S,K)
         weights = weights / jnp.sum(weights, -1, keepdims=True)
-        # combine weights as dense (B,S,E): zero for unselected experts
-        combine = jnp.zeros_like(logits).at[
-            jnp.arange(b)[:, None, None],
-            jnp.arange(s)[None, :, None],
-            sel,
-        ].add(weights)
 
         def epar(name, shape, axes):
             return self.param(
@@ -240,17 +241,42 @@ class MoE(nn.Module):
         w_down = epar("down_proj", (E, f, h), ("expert", "mlp", "embed"))
 
         xc = x.astype(dtype)
-        # dense dispatch: every expert sees every token, weighted combine.
-        # O(E) FLOPs — fine for tests/small E; the Pallas ragged path is the
-        # production kernel (ops/moe TODO).
-        hidden = jnp.einsum("bsh,ehf->ebsf", xc, w_gate.astype(dtype))
-        hidden = nn.silu(hidden) * jnp.einsum("bsh,ehf->ebsf", xc, w_up.astype(dtype))
-        expert_out = jnp.einsum("ebsf,efh->ebsh", hidden, w_down.astype(dtype))
-        out = jnp.einsum("ebsh,bse->bsh", expert_out, combine.astype(dtype))
-        # aux: load-balancing loss (Switch-style)
-        density = jnp.mean(combine > 0, axis=(0, 1))  # fraction routed per expert
-        prob_mean = jnp.mean(jax.nn.softmax(logits, -1), axis=(0, 1))
-        self.sow("intermediates", "moe_aux_loss", E * jnp.sum(density * prob_mean))
+        if cfg.moe_dispatch == "capacity":
+            def experts_fn(buf):  # (E, C, h) -> (E, C, h)
+                hidden = jnp.einsum("ech,ehf->ecf", buf, w_gate.astype(dtype))
+                hidden = nn.silu(hidden) * jnp.einsum(
+                    "ech,ehf->ecf", buf, w_up.astype(dtype)
+                )
+                return jnp.einsum("ecf,efh->ech", hidden, w_down.astype(dtype))
+
+            out = moe_dispatch_combine(
+                xc.reshape(b * s, h),
+                sel.reshape(b * s, K),
+                weights.reshape(b * s, K),
+                experts_fn,
+                E,
+                capacity_factor=cfg.moe_capacity_factor,
+            ).reshape(b, s, h)
+        elif cfg.moe_dispatch == "dense":
+            # combine weights as dense (B,S,E): zero for unselected experts
+            combine = jnp.zeros_like(logits).at[
+                jnp.arange(b)[:, None, None],
+                jnp.arange(s)[None, :, None],
+                sel,
+            ].add(weights)
+            hidden = jnp.einsum("bsh,ehf->ebsf", xc, w_gate.astype(dtype))
+            hidden = nn.silu(hidden) * jnp.einsum(
+                "bsh,ehf->ebsf", xc, w_up.astype(dtype)
+            )
+            expert_out = jnp.einsum("ebsf,efh->ebsh", hidden, w_down.astype(dtype))
+            out = jnp.einsum("ebsh,bse->bsh", expert_out, combine.astype(dtype))
+        else:
+            raise ValueError(
+                f"unknown moe_dispatch {cfg.moe_dispatch!r}; use 'capacity' or 'dense'"
+            )
+        self.sow(
+            "intermediates", "moe_aux_loss", load_balancing_loss(logits, sel, E)
+        )
         return out.astype(x.dtype)
 
 

@@ -95,14 +95,22 @@ def start_measure() -> dict[str, Any]:
     measures: dict[str, Any] = {"time": time.perf_counter()}
     measures["host"] = host_memory_rss()
     for i, d in enumerate(jax.local_devices()):
-        measures[f"device:{i}"] = device_memory_stats(d)["bytes_in_use"]
+        stats = device_memory_stats(d)
+        measures[f"device:{i}"] = stats["bytes_in_use"]
+        measures[f"device:{i}-peak"] = stats["peak_bytes_in_use"]
     _peak_tracker.start()
     return measures
 
 
 def end_measure(start: dict[str, Any]) -> dict[str, Any]:
     """Deltas since :func:`start_measure` (reference ``end_measure``:68):
-    seconds elapsed, host RSS delta + peak, per-device HBM delta + peak."""
+    seconds elapsed, host RSS delta + peak, per-device HBM delta.
+
+    ``device:{i}-peak`` is the HIGH-WATER GROWTH inside the window: XLA has
+    no peak-reset API (unlike torch.cuda.reset_peak_memory_stats), so a
+    region whose allocations stay below an earlier lifetime peak reports 0
+    — use the ``device:{i}`` delta for such regions.
+    """
     out: dict[str, Any] = {"time": time.perf_counter() - start["time"]}
     gc.collect()
     out["host"] = host_memory_rss() - start["host"]
@@ -110,7 +118,9 @@ def end_measure(start: dict[str, Any]) -> dict[str, Any]:
     for i, d in enumerate(jax.local_devices()):
         stats = device_memory_stats(d)
         out[f"device:{i}"] = stats["bytes_in_use"] - start[f"device:{i}"]
-        out[f"device:{i}-peak"] = stats["peak_bytes_in_use"]
+        out[f"device:{i}-peak"] = max(
+            0, stats["peak_bytes_in_use"] - start[f"device:{i}-peak"]
+        )
     return out
 
 
@@ -201,9 +211,6 @@ class ProfileKwargs:
     host_tracer_level: int = 2
     python_tracer_level: int = 0
     create_perfetto_link: bool = False
-
-    def to_handler(self):
-        return self
 
 
 def _start_trace_kwargs(kw: ProfileKwargs) -> dict:

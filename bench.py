@@ -46,8 +46,20 @@ def main():
     from accelerate_tpu import Accelerator
     from accelerate_tpu.models import CausalLM, TransformerConfig, count_params
 
+    variant = sys.argv[1] if len(sys.argv) > 1 else "dense"
     on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
+    if on_tpu and variant == "moe":
+        # Mixtral-family slice (BASELINE.md supporting config): 8 experts,
+        # top-2, sized so fp32 master + AdamW state fits one 16G v5e chip.
+        cfg = TransformerConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=3584,
+            num_layers=4, num_heads=16, num_kv_heads=8, max_seq_len=1024,
+            num_experts=8, num_experts_per_tok=2, moe_dispatch="capacity",
+            moe_capacity_factor=1.25, dtype="bfloat16", remat="dots",
+        )
+        batch_size, seq = 16, 1024
+        iters, warmup = 20, 3
+    elif on_tpu:
         # ~916M params (Llama-8B width, depth cut to fit one 16G v5e chip
         # with fp32 master + AdamW state). remat="dots" saves matmul
         # outputs so backward recomputes only elementwise ops — measured
@@ -59,6 +71,10 @@ def main():
         )
         batch_size, seq = 8, 1024
         iters, warmup = 20, 3
+    elif variant == "moe":
+        cfg = TransformerConfig.tiny(num_experts=4, num_experts_per_tok=2)
+        batch_size, seq = 4, 128
+        iters, warmup = 3, 1
     else:  # CI/CPU smoke: tiny shapes, same code path
         cfg = TransformerConfig.tiny()
         batch_size, seq = 4, 128
@@ -106,6 +122,18 @@ def main():
     matmul_params = n_params
     if not cfg.tie_embeddings:
         matmul_params -= cfg.vocab_size * cfg.hidden_size
+    if cfg.num_experts > 0:
+        # sparse MoE: each token computes only K of E experts — count the
+        # ACTIVE expert params (capacity-padding overhead is real runtime
+        # but not useful FLOPs, so it correctly depresses MFU)
+        expert_params = (
+            cfg.num_experts * 3 * cfg.hidden_size * cfg.intermediate_size
+            * cfg.num_layers
+        )
+        matmul_params -= expert_params
+        matmul_params += (
+            expert_params * cfg.num_experts_per_tok // cfg.num_experts
+        )
     attn_flops_per_token = 6 * seq * cfg.num_heads * cfg.head_dim * cfg.num_layers
     flops_per_token = 6 * matmul_params + attn_flops_per_token
     mfu = tokens_per_sec_chip * flops_per_token / _peak_flops(jax.devices()[0])
