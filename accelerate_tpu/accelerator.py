@@ -270,6 +270,13 @@ class Accelerator:
             elif _is_param_tree(obj):
                 prepared = self.prepare_params(obj, logical_specs=logical_specs)
                 prepared_params = prepared
+            elif _is_flax_module(obj) and self.state.mixed_precision_policy.fp8:
+                # mixed_precision="fp8": swap the model's projections to
+                # fp8 matmuls (the te.convert_model step, reference
+                # utils/transformer_engine.py:36)
+                from .ops.fp8 import convert_model
+
+                prepared = convert_model(obj)
             else:
                 prepared = obj
             result.append(prepared)
@@ -680,11 +687,17 @@ class Accelerator:
         unconditionally."""
         from .utils.profiling import profile as _profile
 
-        if profile_kwargs is None:
-            # the accelerator-level handler supplies options even when an
-            # explicit dir is passed (the dir argument wins over its
-            # output_trace_dir)
+        if profile_kwargs is None and self.profile_handler is not None:
+            # the accelerator-level handler supplies tracer options even
+            # when an explicit dir is passed (the dir argument wins over
+            # its output_trace_dir) — but an explicit-dir call is an ad-hoc
+            # region trace with no step() calls, so skip_first would mean
+            # "never start"; reset it for that case.
             profile_kwargs = self.profile_handler
+            if profile_dir is not None and profile_kwargs.skip_first:
+                import dataclasses as _dc
+
+                profile_kwargs = _dc.replace(profile_kwargs, skip_first=0)
         with _profile(profile_dir, profile_kwargs) as p:
             yield p
 
@@ -872,6 +885,15 @@ def _is_param_tree(obj: Any) -> bool:
             isinstance(l, (jax.Array, np.ndarray)) for l in leaves
         )
     return False
+
+
+def _is_flax_module(obj: Any) -> bool:
+    try:
+        import flax.linen as nn
+
+        return isinstance(obj, nn.Module)
+    except ImportError:  # pragma: no cover
+        return False
 
 
 def _is_schedule(obj: Any) -> bool:

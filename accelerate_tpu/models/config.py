@@ -36,6 +36,9 @@ class TransformerConfig:
     # (the exact-math test oracle)
     moe_dispatch: str = "capacity"
     moe_capacity_factor: float = 2.0
+    # fp8 projections: e4m3 fwd / e5m2 bwd matmuls (ops/fp8.py) — the
+    # TransformerEngine capability; pair with mixed_precision="fp8"
+    fp8: bool = False
     # remat: None | "full" | "dots" — trades FLOPs for HBM
     remat: Optional[str] = None
     # scan over layers: one compiled layer body, num_layers iterations —

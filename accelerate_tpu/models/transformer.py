@@ -72,6 +72,32 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _make_proj(cfg: TransformerConfig, dtype):
+    """The shared no-bias projection factory: nn.Dense, or Fp8Dense when
+    ``cfg.fp8`` (the te.Linear swap, reference utils/transformer_engine.py:36)
+    — same param layout either way, so checkpoints interchange."""
+
+    def proj(name, out_features, axes):
+        kernel_init = nn.with_partitioning(nn.initializers.lecun_normal(), axes)
+        if cfg.fp8:
+            from ..ops.fp8 import Fp8Dense
+
+            return Fp8Dense(
+                out_features, dtype=dtype, param_dtype=jnp.float32,
+                kernel_init=kernel_init, name=name,
+            )
+        return nn.Dense(
+            out_features,
+            use_bias=False,
+            dtype=dtype,
+            param_dtype=jnp.float32,
+            kernel_init=kernel_init,
+            name=name,
+        )
+
+    return proj
+
+
 class Attention(nn.Module):
     config: TransformerConfig
     decode: bool = False
@@ -84,17 +110,7 @@ class Attention(nn.Module):
         q_dim = cfg.num_heads * cfg.head_dim
         kv_dim = cfg.num_kv_heads * cfg.head_dim
 
-        def proj(name, out_features, axes):
-            return nn.Dense(
-                out_features,
-                use_bias=False,
-                dtype=dtype,
-                param_dtype=jnp.float32,
-                kernel_init=nn.with_partitioning(
-                    nn.initializers.lecun_normal(), axes
-                ),
-                name=name,
-            )
+        proj = _make_proj(cfg, dtype)
 
         q = proj("q_proj", q_dim, ("embed", "heads"))(x)
         k = proj("k_proj", kv_dim, ("embed", "kv"))(x)
@@ -170,18 +186,7 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         dtype = _dtype(cfg)
-
-        def proj(name, out_features, axes):
-            return nn.Dense(
-                out_features,
-                use_bias=False,
-                dtype=dtype,
-                param_dtype=jnp.float32,
-                kernel_init=nn.with_partitioning(
-                    nn.initializers.lecun_normal(), axes
-                ),
-                name=name,
-            )
+        proj = _make_proj(cfg, dtype)
 
         gate = proj("gate_proj", cfg.intermediate_size, ("embed", "mlp"))(x)
         up = proj("up_proj", cfg.intermediate_size, ("embed", "mlp"))(x)

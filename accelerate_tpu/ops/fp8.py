@@ -1,0 +1,145 @@
+"""FP8 training ops: e4m3 forward / e5m2 backward matmuls with per-tensor
+current scaling.
+
+Parity: reference ``utils/transformer_engine.py:36`` (``convert_model``
+swaps nn.Linear -> te.Linear) + ``FP8RecipeKwargs`` (utils/dataclasses.py:
+271 — DelayedScaling recipe). TPU-native redesign: no module swapping —
+:class:`Fp8Dense` is a drop-in for ``nn.Dense`` whose matmul runs through
+:func:`fp8_matmul`, a ``custom_vjp`` that
+
+* quantizes activations and weights to ``float8_e4m3fn`` (narrow range,
+  high precision) with a per-tensor scale chosen from the CURRENT amax
+  (TE's "current scaling" recipe — stateless, so nothing new threads
+  through the train carry),
+* multiplies in the fp8 domain (XLA emits native fp8 MXU ops on hardware
+  that has them; elsewhere the upcast-matmul is numerically identical
+  because every fp8 code is exactly representable in bf16/f32),
+* casts incoming gradients to ``float8_e5m2`` (wide range, low precision —
+  gradients need dynamic range, not mantissa) for both backward matmuls.
+
+Master params stay fp32 and the optimizer update is untouched — exactly
+the TE integration's split of duties.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+_EPS = 1e-12
+
+
+def _scale_for(x: jax.Array, fmax: float) -> jax.Array:
+    """Per-tensor scale s so that s*amax lands on the format's max."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    return fmax / jnp.maximum(amax, _EPS)
+
+
+def quantize_fp8(x: jax.Array, dtype: Any, scale: jax.Array) -> jax.Array:
+    fmax = E4M3_MAX if dtype == jnp.float8_e4m3fn else E5M2_MAX
+    scaled = jnp.clip(x.astype(jnp.float32) * scale, -fmax, fmax)
+    return scaled.astype(dtype)
+
+
+@jax.custom_vjp
+def fp8_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """``x @ w`` with fp8 storage/compute: x (..., k), w (k, n) -> (..., n)
+    in float32 (cast at the call site)."""
+    out, _ = _fp8_matmul_fwd(x, w)
+    return out
+
+
+def _fp8_matmul_fwd(x, w):
+    xs = _scale_for(x, E4M3_MAX)
+    ws = _scale_for(w, E4M3_MAX)
+    xq = quantize_fp8(x, jnp.float8_e4m3fn, xs)
+    wq = quantize_fp8(w, jnp.float8_e4m3fn, ws)
+    out = jnp.einsum(
+        "...k,kn->...n",
+        xq.astype(jnp.bfloat16),
+        wq.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ) / (xs * ws)
+    # residuals are the fp8 codes + scales — TE's memory win: backward
+    # never sees the bf16/f32 originals
+    return out, (xq, wq, xs, ws)
+
+
+def _fp8_matmul_bwd(res, g):
+    xq, wq, xs, ws = res
+    gs = _scale_for(g, E5M2_MAX)
+    gq = quantize_fp8(g, jnp.float8_e5m2, gs)
+    dx = jnp.einsum(
+        "...n,kn->...k",
+        gq.astype(jnp.bfloat16),
+        wq.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ) / (gs * ws)
+    dw = jnp.einsum(
+        "...k,...n->kn",
+        xq.astype(jnp.bfloat16),
+        gq.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ) / (xs * gs)
+    return dx.astype(jnp.float32), dw.astype(jnp.float32)
+
+
+fp8_matmul.defvjp(_fp8_matmul_fwd, _fp8_matmul_bwd)
+
+
+def convert_model(model: nn.Module) -> nn.Module:
+    """Return a copy of ``model`` with fp8 projections enabled — the
+    ``te.convert_model`` entry (reference utils/transformer_engine.py:36).
+
+    Works on any module whose dataclass config carries an ``fp8`` flag
+    (``TransformerConfig`` does); other modules are returned unchanged
+    with a warning — they opt in by using :class:`Fp8Dense` directly.
+    """
+    import dataclasses
+
+    from ..logging import get_logger
+
+    cfg = getattr(model, "config", None)
+    if cfg is not None and dataclasses.is_dataclass(cfg) and hasattr(cfg, "fp8"):
+        if cfg.fp8:
+            return model
+        return model.clone(config=dataclasses.replace(cfg, fp8=True))
+    get_logger(__name__).warning(
+        f"cannot auto-convert {type(model).__name__} to fp8 (no config.fp8 "
+        "field); use accelerate_tpu.ops.fp8.Fp8Dense in its definition"
+    )
+    return model
+
+
+class Fp8Dense(nn.Module):
+    """Drop-in ``nn.Dense`` (no-bias) whose matmul runs in fp8 — the
+    te.Linear swap target (reference utils/transformer_engine.py:36)."""
+
+    features: int
+    use_bias: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", self.kernel_init, (x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        k = kernel.unbox() if hasattr(kernel, "unbox") else kernel
+        out = fp8_matmul(x.astype(jnp.float32), k.astype(jnp.float32))
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,),
+                self.param_dtype,
+            )
+            b = bias.unbox() if hasattr(bias, "unbox") else bias
+            out = out + b
+        return out.astype(self.dtype)

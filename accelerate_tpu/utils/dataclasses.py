@@ -142,6 +142,10 @@ class MixedPrecisionPolicy(KwargsHandler):
     compute_dtype: Any = jnp.float32
     output_dtype: Any = jnp.float32
     grad_dtype: Any = None  # accumulation-buffer dtype; None -> float32
+    # fp8 projections requested (reference FP8RecipeKwargs): matmuls run
+    # e4m3-fwd/e5m2-bwd (ops/fp8.py) in models built with
+    # ``TransformerConfig(fp8=True)``; non-matmul compute stays bf16.
+    fp8: bool = False
     # fp16 only: dynamic loss scaling (GradScaler parity).
     loss_scale_init: float = 2.0**15
     loss_scale_growth_interval: int = 2000
@@ -157,8 +161,10 @@ class MixedPrecisionPolicy(KwargsHandler):
         if precision == PrecisionType.FP16:
             return cls(compute_dtype=jnp.float16)
         if precision == PrecisionType.FP8:
-            # fp8 matmul inputs, bf16 accumulate/everything-else.
-            return cls(compute_dtype=jnp.bfloat16)
+            # fp8 matmul inputs, bf16 accumulate/everything-else. The
+            # matmul swap itself lives in the model (TransformerConfig.fp8
+            # -> ops/fp8.Fp8Dense); custom models use Fp8Dense directly.
+            return cls(compute_dtype=jnp.bfloat16, fp8=True)
         raise ValueError(f"unknown precision {precision}")
 
     @property

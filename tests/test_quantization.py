@@ -1,0 +1,136 @@
+"""Weight-only int8/int4 quantization tests (reference tests/test_quantization
+/ utils/bnb.py capability: load_and_quantize_model + skip modules)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.checkpointing import save_model_weights
+from accelerate_tpu.models import CausalLM, TransformerConfig
+from accelerate_tpu.utils.quantization import (
+    QuantizationConfig,
+    QuantizedTensor,
+    dequantize_tree,
+    is_quantized,
+    load_and_quantize_model,
+    quantize_params,
+    quantize_tensor,
+    quantized_apply,
+)
+
+
+def test_int8_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 128))
+    q = quantize_tensor(w, bits=8)
+    assert q.codes.dtype == jnp.int8 and q.codes.shape == w.shape
+    err = jnp.abs(q.dequantize() - w)
+    # absmax/127 is the max per-column step; error <= step/2 + rounding
+    col_step = jnp.max(jnp.abs(w), axis=0) / 127.0
+    assert float(jnp.max(err / col_step[None, :])) <= 0.51
+    rel = float(jnp.linalg.norm(q.dequantize() - w) / jnp.linalg.norm(w))
+    assert rel < 0.01
+
+
+def test_int4_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(1), (256, 64))
+    q = quantize_tensor(w, bits=4, block_size=64)
+    # packed: half the rows
+    assert q.codes.shape == (128, 64)
+    assert q.dequantize().shape == (256, 64)
+    rel = float(jnp.linalg.norm(q.dequantize() - w) / jnp.linalg.norm(w))
+    assert rel < 0.12  # 4-bit blockwise: coarse but bounded
+
+
+def test_int4_block_scales_shape():
+    w = jax.random.normal(jax.random.PRNGKey(2), (128, 32))
+    q = quantize_tensor(w, bits=4, block_size=32)
+    assert q.scales.shape == (4, 32)  # 128/32 blocks x out
+    assert q.nbytes < w.size  # < 1 byte per element incl. scales
+
+
+def test_memory_savings():
+    w = jnp.ones((512, 512), jnp.float32)
+    q8 = quantize_tensor(w, bits=8)
+    q4 = quantize_tensor(w, bits=4)
+    assert q8.nbytes < w.nbytes / 3.9
+    # 4 bits/elem + fp32 scale per 64-block = ~4.5 bits/elem => ~7.1x
+    assert q4.nbytes < w.nbytes / 7.0
+
+
+def test_quantize_params_skips_and_config_validation():
+    with pytest.raises(ValueError):
+        QuantizationConfig()  # neither bit-width chosen
+    with pytest.raises(ValueError):
+        QuantizationConfig(load_in_8bit=True, load_in_4bit=True)
+    cfg = QuantizationConfig(load_in_8bit=True, min_weight_size=16)
+    params = {
+        "layer": {"kernel": jnp.ones((64, 64)), "bias": jnp.ones((64,))},
+        "embed": {"table": jnp.ones((64, 64))},
+        "norm": {"scale": jnp.ones((8, 8))},
+    }
+    q = quantize_params(params, cfg)
+    assert is_quantized(q["layer"]["kernel"])
+    assert not is_quantized(q["layer"]["bias"])  # 1-dim + "bias" skip
+    assert not is_quantized(q["embed"]["table"])  # skip list
+    assert not is_quantized(q["norm"]["scale"])  # skip list
+
+
+def test_quantized_tensor_is_pytree_and_jits():
+    q = quantize_tensor(jnp.ones((32, 16)), bits=8)
+    leaves = jax.tree.leaves(q)
+    assert len(leaves) == 2  # codes + scales
+
+    @jax.jit
+    def matmul(qt, x):
+        return x @ qt.dequantize(jnp.float32)
+
+    out = matmul(q, jnp.ones((4, 32)))
+    np.testing.assert_allclose(np.asarray(out), 32.0, rtol=1e-5)
+
+
+def test_quantized_model_forward_close_to_fp32():
+    cfg = TransformerConfig.tiny()
+    model = CausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 32)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    ref = model.apply({"params": params}, ids)
+
+    qcfg = QuantizationConfig(load_in_8bit=True, min_weight_size=256)
+    qparams = quantize_params(params, qcfg)
+    assert any(is_quantized(l) for l in jax.tree.leaves(
+        qparams, is_leaf=is_quantized))
+    out = quantized_apply(model.apply, qparams, ids, dtype=jnp.float32)
+    # weight-only int8: logits deviate slightly; correlation must survive
+    a, b = np.asarray(ref).ravel(), np.asarray(out).ravel()
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+    assert cos > 0.999, cos
+
+
+def test_load_and_quantize_model(tmp_path):
+    cfg = TransformerConfig.tiny()
+    model = CausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    save_model_weights(params, str(tmp_path))
+    abstract = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), params
+    )
+    qcfg = QuantizationConfig(load_in_8bit=True, min_weight_size=256)
+    loaded = load_and_quantize_model(abstract, str(tmp_path), qcfg)
+    n_q = sum(is_quantized(l) for l in jax.tree.leaves(
+        loaded, is_leaf=is_quantized))
+    assert n_q > 0
+    # dequantized values match a direct quantize of the originals
+    deq = dequantize_tree(loaded)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(deq)[0],
+    ):
+        rel = float(
+            jnp.linalg.norm(jnp.asarray(a, jnp.float32) - b)
+            / (jnp.linalg.norm(a) + 1e-9)
+        )
+        assert rel < 0.02, (pa, rel)
