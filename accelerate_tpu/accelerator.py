@@ -320,14 +320,21 @@ class Accelerator:
     # reference-name alias
     prepare_model = prepare_params
 
-    def prepare_data_loader(self, dataloader: Any) -> DataLoaderShard:
+    def prepare_data_loader(
+        self, dataloader: Any, dispatch_batches: Optional[bool] = None
+    ) -> DataLoaderShard:
         if isinstance(dataloader, DataLoaderShard):
             self._dataloaders.append(dataloader)
             return dataloader
+        config = self.state.dataloader_config
+        if dispatch_batches is not None:
+            import dataclasses as _dc
+
+            config = _dc.replace(config, dispatch_batches=dispatch_batches)
         prepared = prepare_data_loader(
             dataloader,
             self.state,
-            self.state.dataloader_config,
+            config,
         )
         self._dataloaders.append(prepared)
         return prepared

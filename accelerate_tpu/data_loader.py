@@ -548,8 +548,27 @@ def prepare_data_loader(
             even_batches=config.even_batches,
         )
 
+        dispatching = bool(config.dispatch_batches) and num_processes > 1
+        # Dispatcher mode: ONLY rank 0 runs the factory and must produce the
+        # whole GLOBAL batch (the dispatcher slices per process afterwards)
+        # — a per-process shard here would get sliced twice, silently
+        # dropping (num_processes-1)/num_processes of every batch.
+        factory_shard = (
+            BatchSamplerShard(
+                sampler,
+                shard.global_batch_size,
+                drop_last=drop_last,
+                num_processes=1,
+                process_index=0,
+                split_batches=False,
+                even_batches=config.even_batches,
+            )
+            if dispatching
+            else shard
+        )
+
         def factory():
-            for local_indices, valid in iter(shard):
+            for local_indices, valid in iter(factory_shard):
                 items = [dataset[i] for i in local_indices]
                 yield collate(items), valid
 
@@ -561,12 +580,8 @@ def prepare_data_loader(
                 f"divisible by the data-parallel device count {data_degree} so XLA can "
                 f"shard the batch. Increase batch_size, or reduce the dp/fsdp mesh axes."
             )
-        num_batches = len(shard)
-        cls = (
-            DataLoaderDispatcher
-            if (config.dispatch_batches and num_processes > 1)
-            else DataLoaderShard
-        )
+        num_batches = len(factory_shard)
+        cls = DataLoaderDispatcher if dispatching else DataLoaderShard
         out = cls(
             factory,
             num_batches,

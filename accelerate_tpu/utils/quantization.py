@@ -226,15 +226,41 @@ def dequantize_tree(params: Any, dtype: Any = None) -> Any:
     )
 
 
-def quantized_apply(apply_fn: Callable, qparams: Any, *args, dtype=None, **kw):
-    """Run ``apply_fn({"params": dequantized}, *args)`` under jit with the
-    dequant inside the traced program (weight-only inference entry)."""
-
+def _jitted_quantized_apply(apply_fn: Callable, dtype) -> Callable:
     @jax.jit
     def _run(qp, *a):
-        return apply_fn({"params": dequantize_tree(qp, dtype)}, *a, **kw)
+        return apply_fn({"params": dequantize_tree(qp, dtype)}, *a)
 
-    return _run(qparams, *args)
+    return _run
+
+
+_jit_cache: dict[Any, Callable] = {}
+
+
+def quantized_apply(apply_fn: Callable, qparams: Any, *args, dtype=None, **kw):
+    """Run ``apply_fn({"params": dequantized}, *args)`` under jit with the
+    dequant inside the traced program (weight-only inference entry).
+
+    The jitted program is cached per ``(apply_fn, dtype)`` so repeated
+    calls (generation loops) do not re-trace; kwargs defeat the cache and
+    re-jit each call — thread them through ``args`` where possible.
+    """
+    if kw:
+        @jax.jit
+        def _run(qp, *a):
+            return apply_fn({"params": dequantize_tree(qp, dtype)}, *a, **kw)
+
+        return _run(qparams, *args)
+    try:
+        key = (apply_fn, jnp.dtype(dtype) if dtype is not None else None)
+        hash(key)
+    except TypeError:
+        key = None
+    if key is None:
+        return _jitted_quantized_apply(apply_fn, dtype)(qparams, *args)
+    if key not in _jit_cache:
+        _jit_cache[key] = _jitted_quantized_apply(apply_fn, dtype)
+    return _jit_cache[key](qparams, *args)
 
 
 def load_and_quantize_model(
@@ -257,7 +283,10 @@ def load_and_quantize_model(
         arr = read(name)
         eligible = (
             arr.ndim >= 2
-            and np.issubdtype(arr.dtype, np.floating)
+            # jnp.issubdtype, NOT np.issubdtype: numpy does not consider
+            # ml_dtypes.bfloat16 a floating dtype, which would silently
+            # skip every weight of a bf16 checkpoint
+            and jnp.issubdtype(arr.dtype, jnp.floating)
             and arr.size >= config.min_weight_size
             and not any(s in name for s in config.skip_modules)
         )

@@ -39,3 +39,13 @@ def test_debug_launcher_local_sgd():
     )
 
     debug_launcher(local_sgd_worker, num_processes=2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("world", [2, 4])
+def test_debug_launcher_full_test_script(world):
+    """The reference runs its whole in-package assertion script under the
+    launcher (test_utils/scripts/test_script.py); same here at world 2/4."""
+    from accelerate_tpu.test_utils.scripts.test_script import run_all_checks
+
+    debug_launcher(run_all_checks, num_processes=world)
