@@ -45,10 +45,12 @@ class ClusterConfig:
     gradient_accumulation_steps: int = 1
     # mesh degrees
     dp_size: int = -1
+    pp_size: int = 1
     fsdp_size: int = 1
     tp_size: int = 1
     sp_size: int = 1
     ep_size: int = 1
+    num_micro_batches: int = 1
     sharding_strategy: str = "full_shard"
     # pod fan-out
     tpu_name: Optional[str] = None
@@ -100,6 +102,8 @@ class ClusterConfig:
                 self.gradient_accumulation_steps
             ),
             ENV_PREFIX + "DP_SIZE": str(self.dp_size),
+            ENV_PREFIX + "PP_SIZE": str(self.pp_size),
+            ENV_PREFIX + "NUM_MICRO_BATCHES": str(self.num_micro_batches),
             ENV_PREFIX + "FSDP_SIZE": str(self.fsdp_size),
             ENV_PREFIX + "TP_SIZE": str(self.tp_size),
             ENV_PREFIX + "SP_SIZE": str(self.sp_size),
@@ -115,9 +119,33 @@ class ClusterConfig:
         return env
 
 
-def _ask(prompt: str, default: Any, cast=str):
-    raw = input(f"{prompt} [{default}]: ").strip()
-    return cast(raw) if raw else default
+def _ask(prompt: str, default: Any, cast=str, validate=None):
+    """One free-form question; re-asks until ``cast``+``validate`` accept
+    (reference _ask_field commands/config/config_utils.py:41)."""
+    while True:
+        raw = input(f"{prompt} [{default}]: ").strip()
+        try:
+            value = cast(raw) if raw else default
+        except (TypeError, ValueError):
+            print(f"  invalid value {raw!r}, try again")
+            continue
+        if validate is not None and not validate(value):
+            print(f"  {value!r} not allowed here, try again")
+            continue
+        return value
+
+
+def _ask_options(prompt: str, options: list[str], default_index: int = 0) -> str:
+    """Numbered-menu question (reference _ask_options + the arrow-key menu
+    commands/config/menu/selection_menu.py — numbered input works over ssh
+    and in dumb terminals, which is where TPU pods are configured)."""
+    print(prompt)
+    for i, opt in enumerate(options):
+        print(f"  [{i}] {opt}")
+    idx = _ask(
+        "choice", default_index, int, validate=lambda v: 0 <= v < len(options)
+    )
+    return options[idx]
 
 
 def get_user_input() -> ClusterConfig:
@@ -125,24 +153,61 @@ def get_user_input() -> ClusterConfig:
     print("accelerate_tpu configuration")
     print("----------------------------")
     cfg = ClusterConfig()
-    cfg.num_machines = _ask("How many hosts (machines)?", 1, int)
+    env = _ask_options(
+        "Where will the job run?",
+        ["LOCAL_MACHINE", "TPU_POD (gcloud fan-out)"],
+    )
+    cfg.compute_environment = "TPU_POD" if env.startswith("TPU_POD") else env
+    if cfg.compute_environment == "TPU_POD":
+        cfg.tpu_name = _ask("TPU pod name (gcloud)?", "", str) or None
+        cfg.tpu_zone = _ask("TPU zone?", "", str) or None
+    cfg.num_machines = _ask(
+        "How many hosts (machines)?", 1, int, validate=lambda v: v >= 1
+    )
     if cfg.num_machines > 1:
-        cfg.machine_rank = _ask("Rank of this machine?", 0, int)
+        cfg.machine_rank = _ask(
+            "Rank of this machine?", 0, int,
+            validate=lambda v: 0 <= v < cfg.num_machines,
+        )
         cfg.main_process_ip = _ask("Coordinator (rank 0) IP?", "", str) or None
         cfg.main_process_port = _ask("Coordinator port?", 8476, int)
-    cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16)?", "bf16")
-    cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps?", 1, int)
-    cfg.fsdp_size = _ask("FSDP (parameter-sharding) degree (1=off, -1=all)?", 1, int)
-    cfg.tp_size = _ask("Tensor-parallel degree?", 1, int)
-    cfg.sp_size = _ask("Sequence-parallel (ring attention) degree?", 1, int)
-    cfg.ep_size = _ask("Expert-parallel degree (MoE)?", 1, int)
-    cfg.dp_size = _ask("Data-parallel degree (-1 = remaining chips)?", -1, int)
+    cfg.mixed_precision = _ask_options(
+        "Mixed precision?", ["bf16", "no", "fp16", "fp8"], 0
+    )
+    cfg.gradient_accumulation_steps = _ask(
+        "Gradient accumulation steps?", 1, int, validate=lambda v: v >= 1
+    )
+    deg = lambda v: v == -1 or v >= 1  # noqa: E731
+    cfg.fsdp_size = _ask(
+        "FSDP (parameter-sharding) degree (1=off, -1=all)?", 1, int, deg
+    )
+    if cfg.fsdp_size != 1:
+        cfg.sharding_strategy = _ask_options(
+            "Sharding strategy?",
+            ["full_shard", "shard_grad_op", "shard_opt", "hybrid_shard"],
+        )
+    cfg.tp_size = _ask("Tensor-parallel degree?", 1, int, deg)
+    cfg.sp_size = _ask("Sequence-parallel (ring attention) degree?", 1, int, deg)
+    cfg.ep_size = _ask("Expert-parallel degree (MoE)?", 1, int, deg)
+    cfg.pp_size = _ask("Pipeline-parallel degree?", 1, int, deg)
+    if cfg.pp_size != 1:
+        # -1 (auto) included: microbatches must cover whatever pp resolves
+        # to, or validate_pipeline_plugin rejects the launch
+        floor = cfg.pp_size if cfg.pp_size > 1 else 2
+        cfg.num_micro_batches = _ask(
+            f"Pipeline microbatches (>= pipeline degree, >= {floor})?",
+            max(floor, 2), int, validate=lambda v: v >= floor,
+        )
+    cfg.dp_size = _ask("Data-parallel degree (-1 = remaining chips)?", -1, int, deg)
     return cfg
 
 
 def config_command(args) -> None:
-    cfg = get_user_input()
-    path = cfg.save(args.config_file)
+    if getattr(args, "default", False):
+        path = write_basic_config(save_location=args.config_file)
+    else:
+        cfg = get_user_input()
+        path = cfg.save(args.config_file)
     print(f"Configuration saved at {path}")
 
 
@@ -160,6 +225,10 @@ def config_command_parser(subparsers=None) -> argparse.ArgumentParser:
     else:
         parser = argparse.ArgumentParser("accelerate-tpu config")
     parser.add_argument("--config_file", default=None, help="Where to save")
+    parser.add_argument(
+        "--default", action="store_true",
+        help="Write the defaults without asking questions",
+    )
     if subparsers is not None:
         parser.set_defaults(func=config_command)
     return parser

@@ -27,8 +27,43 @@ def _human(n: float) -> str:
     return f"{n:.2f} PB"
 
 
+def estimate_activation_bytes(
+    cfg, batch_size: int, seq_len: int, remat: Optional[str], dtype: str
+) -> dict:
+    """Activation memory for one train step — the term users get wrong when
+    budgeting HBM (the reference documents params-only as its assumption;
+    here activations are first-class because remat changes them 10x).
+
+    Model: per layer, the saved residuals depend on the remat policy —
+    "full" keeps only each layer's input; "dots" (the bench default) keeps
+    matmul outputs (qkv/o projections, gate/up/down); None keeps those plus
+    the elementwise intermediates. The lm-head logits (+fp32 softmax) are
+    counted separately: at large vocab they dominate and remat cannot
+    remove them.
+    """
+    h, f, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    qkv = (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
+    itemsize = jnp.dtype(dtype).itemsize
+    if remat == "full":
+        per_layer = h
+    elif remat == "dots":
+        per_layer = 2 * h + qkv + 2 * f
+    else:
+        per_layer = 2 * h + qkv + 3 * f + 2 * h
+    tokens = batch_size * seq_len
+    layer_bytes = tokens * per_layer * L * itemsize
+    # logits in compute dtype + the fp32 softmax/loss intermediates
+    logits_bytes = tokens * cfg.vocab_size * (itemsize + 4)
+    return {
+        "activation_bytes": int(layer_bytes),
+        "logits_bytes": int(logits_bytes),
+    }
+
+
 def estimate_from_config(preset_or_json: str, dtype: str = "bfloat16",
-                         grad_accum: bool = False) -> dict:
+                         grad_accum: bool = False, batch_size: int = 8,
+                         seq_len: int = 2048,
+                         remat: Optional[str] = "dots") -> dict:
     from ..models import CausalLM, TransformerConfig
 
     presets = {
@@ -69,6 +104,7 @@ def estimate_from_config(preset_or_json: str, dtype: str = "bfloat16",
     inference = n_params * itemsize
     # training: fp32 master + 2 AdamW moments (fp32) + compute-dtype cast
     train = n_params * (4 + 8 + itemsize + (4 if grad_accum else 0))
+    acts = estimate_activation_bytes(cfg, batch_size, seq_len, remat, dtype)
     return {
         "params": n_params,
         "largest_layer": max(
@@ -76,17 +112,32 @@ def estimate_from_config(preset_or_json: str, dtype: str = "bfloat16",
         ),
         "inference_bytes": inference,
         "training_bytes": train,
+        "training_total_bytes": (
+            train + acts["activation_bytes"] + acts["logits_bytes"]
+        ),
+        **acts,
+        "batch_size": batch_size,
+        "seq_len": seq_len,
+        "remat": remat,
         "dtype": dtype,
     }
 
 
 def estimate_command(args) -> None:
     for dtype in args.dtypes:
-        info = estimate_from_config(args.model_name, dtype, args.grad_accum)
+        info = estimate_from_config(
+            args.model_name, dtype, args.grad_accum,
+            batch_size=args.batch_size, seq_len=args.seq_len,
+            remat=None if args.remat == "none" else args.remat,
+        )
         print(
             f"{args.model_name} [{dtype}]: {info['params'] / 1e9:.2f}B params | "
             f"inference {_human(info['inference_bytes'])} | "
-            f"training (AdamW) {_human(info['training_bytes'])} | "
+            f"training state (AdamW) {_human(info['training_bytes'])} | "
+            f"activations@B{args.batch_size}xS{args.seq_len} "
+            f"{_human(info['activation_bytes'] + info['logits_bytes'])} "
+            f"(remat={info['remat']}) | "
+            f"training total {_human(info['training_total_bytes'])} | "
             f"largest layer {_human(info['largest_layer'])}"
         )
 
@@ -101,6 +152,11 @@ def estimate_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("model_name", help="Preset name or config.json path")
     parser.add_argument("--dtypes", nargs="+", default=["bfloat16", "float32"])
     parser.add_argument("--grad_accum", action="store_true")
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--seq_len", type=int, default=2048)
+    parser.add_argument("--remat", choices=["none", "dots", "full"],
+                        default="dots",
+                        help="Remat policy assumed for the activation term")
     if subparsers is not None:
         parser.set_defaults(func=estimate_command)
     return parser

@@ -122,16 +122,16 @@ def debug_launcher_command(args, cfg: ClusterConfig) -> int:
 def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
     """Fan the same launch out to every pod worker over gcloud ssh
     (reference tpu_pod_launcher :827 / tpu.py:90)."""
+    from .tpu import build_gcloud_ssh_command
+
     inner = (
         f"cd {os.getcwd()} && "
         f"accelerate-tpu launch --machine_rank $(hostname | grep -o '[0-9]*$') "
         f"{args.training_script} {' '.join(args.training_script_args)}"
     )
-    cmd = [
-        "gcloud", "compute", "tpus", "tpu-vm", "ssh", cfg.tpu_name or "tpu",
-        f"--zone={cfg.tpu_zone or 'us-central2-b'}", "--worker=all",
-        f"--command={inner}",
-    ]
+    cmd = build_gcloud_ssh_command(
+        cfg.tpu_name or "tpu", inner, cfg.tpu_zone
+    )
     print("Running:", " ".join(cmd))
     return subprocess.call(cmd)
 

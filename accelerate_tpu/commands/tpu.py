@@ -1,0 +1,102 @@
+"""`accelerate-tpu tpu-config` — run commands on every worker of a TPU pod.
+
+Parity: reference ``commands/tpu.py`` (``tpu_command_launcher``:90 — wraps
+``gcloud alpha compute tpus tpu-vm ssh --worker=all --command=...``, with
+``--install_accelerate`` bootstrapping and ``--debug`` printing instead of
+running). Same shape here: the pod's hosts are reached through gcloud ssh
+fan-out; the framework itself is hostname-agnostic (jax.distributed does
+the rendezvous once processes start).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+from typing import Optional
+
+from .config import ClusterConfig, default_config_file
+
+_DEFAULT_CMD = ["cd /usr/share"]
+
+
+def build_gcloud_ssh_command(
+    tpu_name: str, command: str, tpu_zone: Optional[str] = None
+) -> list[str]:
+    """The single gcloud pod fan-out invocation — shared by `tpu-config`
+    and `launch --gcloud` so the two cannot drift."""
+    out = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+        "--worker", "all",
+        "--command", command,
+    ]
+    if tpu_zone:
+        out += ["--zone", tpu_zone]
+    return out
+
+
+def build_pod_command(args) -> list[str]:
+    """Assemble the gcloud fan-out command line (pure — testable without
+    gcloud)."""
+    cfg: Optional[ClusterConfig] = None
+    config_path = args.config_file or default_config_file()
+    if os.path.isfile(config_path):
+        cfg = ClusterConfig.load(config_path)
+    tpu_name = args.tpu_name or (cfg.tpu_name if cfg else None)
+    tpu_zone = args.tpu_zone or (cfg.tpu_zone if cfg else None)
+    if not tpu_name:
+        raise ValueError(
+            "no TPU name: pass --tpu_name or set tpu_name in the config "
+            "(accelerate-tpu config)"
+        )
+
+    commands = list(_DEFAULT_CMD)
+    if args.install_accelerate:
+        commands.append("pip install accelerate_tpu -U")
+    for cmd in args.command or []:
+        commands.append(cmd)
+    if len(commands) == len(_DEFAULT_CMD):
+        raise ValueError(
+            "no command to run: pass --command (repeatable) and/or "
+            "--install_accelerate"
+        )
+    joined = "; ".join(commands)
+    return build_gcloud_ssh_command(tpu_name, joined, tpu_zone)
+
+
+def tpu_command(args) -> None:
+    cmd = build_pod_command(args)
+    if args.debug:
+        print(f"Running {' '.join(cmd)}")
+        return
+    print(f"Running {' '.join(cmd)} on every pod worker...")
+    subprocess.run(cmd, check=True)
+    print("Successfully run command on every pod worker")
+
+
+def tpu_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "tpu-config", help="Run commands on all TPU pod workers"
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu tpu-config")
+    parser.add_argument("--config_file", default=None,
+                        help="Launch config with tpu_name/tpu_zone")
+    parser.add_argument("--tpu_name", default=None)
+    parser.add_argument("--tpu_zone", default=None)
+    parser.add_argument(
+        "--command", action="append",
+        help="Command to run on every worker (repeatable)",
+    )
+    parser.add_argument(
+        "--install_accelerate", action="store_true",
+        help="Install/upgrade accelerate_tpu on every worker first",
+    )
+    parser.add_argument(
+        "--debug", action="store_true",
+        help="Print the gcloud command instead of running it",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=tpu_command)
+    return parser

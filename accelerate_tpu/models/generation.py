@@ -65,9 +65,17 @@ def generate(
             f"exceeds max_seq_len ({model.config.max_seq_len})"
         )
     key = key if key is not None else jax.random.PRNGKey(0)
-    cache = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32), decode=True
-    )["cache"]
+    # cache template via eval_shape + zeros: a full model.init here would
+    # materialize (and randomly initialize) an entire spare parameter tree
+    # just to learn the cache shapes — pure HBM/time waste at 8B+ scale
+    cache_shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32), decode=True
+        )["cache"]
+    )
+    cache = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    )
 
     # prefill the whole prompt in one forward
     logits, mutated = model.apply(

@@ -1,8 +1,14 @@
+from .compat import (
+    DeepSpeedPlugin,
+    FullyShardedDataParallelPlugin,
+    MegatronLMPlugin,
+)
 from .constants import (
     MESH_AXES,
     MESH_AXIS_DATA,
     MESH_AXIS_EXPERT,
     MESH_AXIS_FSDP,
+    MESH_AXIS_PIPELINE,
     MESH_AXIS_SEQUENCE,
     MESH_AXIS_TENSOR,
 )
@@ -52,5 +58,21 @@ from .operations import (
     reduce,
     send_to_device,
     slice_tensors,
+)
+from .profiling import (
+    ProfileKwargs,
+    StepTimer,
+    annotate,
+    end_measure,
+    profile,
+    start_measure,
+)
+from .quantization import (
+    QuantizationConfig,
+    QuantizedTensor,
+    dequantize_tree,
+    load_and_quantize_model,
+    quantize_params,
+    quantized_apply,
 )
 from .random import KeyChain, set_seed, synchronize_rng_state, synchronize_rng_states

@@ -1,0 +1,160 @@
+"""Migration shims: reference plugin names -> :class:`ParallelismPlugin`.
+
+A user porting a script from the reference (HF Accelerate) brings
+``DeepSpeedPlugin`` / ``FullyShardedDataParallelPlugin`` /
+``MegatronLMPlugin`` constructor calls (reference utils/dataclasses.py:739,
+1075, 1311). On TPU all three describe the same thing — a sharding layout
+over the device mesh — so each shim maps the familiar knobs onto a
+:class:`ParallelismPlugin` and ignores (with a log line) engine-specific
+options that have no TPU meaning (NVMe offload paths, bucket sizes, ...).
+
+These are factory FUNCTIONS, not classes: the object you get back is a
+plain ParallelismPlugin, so the rest of the framework has exactly one
+parallelism config type.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..logging import get_logger
+from .dataclasses import ParallelismPlugin, ShardingStrategy
+
+logger = get_logger(__name__)
+
+_ZERO_TO_STRATEGY = {
+    0: ShardingStrategy.NO_SHARD,
+    1: ShardingStrategy.SHARD_OPT,
+    2: ShardingStrategy.SHARD_GRAD_OP,
+    3: ShardingStrategy.FULL_SHARD,
+}
+
+
+def _warn_ignored(name: str, kwargs: dict) -> None:
+    dropped = {k: v for k, v in kwargs.items() if v is not None}
+    if dropped:
+        logger.info(
+            f"{name}: ignoring engine-specific options with no TPU "
+            f"equivalent: {sorted(dropped)}"
+        )
+
+
+def DeepSpeedPlugin(
+    zero_stage: int = 2,
+    gradient_accumulation_steps: Optional[int] = None,
+    offload_optimizer_device: Optional[str] = None,
+    offload_param_device: Optional[str] = None,
+    **ignored: Any,
+) -> ParallelismPlugin:
+    """ZeRO stages -> sharding strategies (reference utils/dataclasses.py:739).
+
+    stage 0 = DDP (replicated), 1 = optimizer-state sharding, 2 = +gradient
+    sharding, 3 = full parameter sharding. Offload devices map to the
+    big-model host/disk tiers and are not part of the train-step plugin.
+    """
+    if zero_stage not in _ZERO_TO_STRATEGY:
+        raise ValueError(f"zero_stage must be 0-3, got {zero_stage}")
+    if gradient_accumulation_steps is not None:
+        import os
+
+        from .constants import ENV_PREFIX
+
+        os.environ[ENV_PREFIX + "GRADIENT_ACCUMULATION_STEPS"] = str(
+            gradient_accumulation_steps
+        )
+    _warn_ignored(
+        "DeepSpeedPlugin",
+        {
+            "offload_optimizer_device": offload_optimizer_device,
+            "offload_param_device": offload_param_device,
+            **ignored,
+        },
+    )
+    strategy = _ZERO_TO_STRATEGY[zero_stage]
+    if zero_stage > 0:
+        # every device joins the sharding group (DeepSpeed's world-wide
+        # partitioning); dp_size must be pinned so only fsdp is auto
+        return ParallelismPlugin(
+            dp_size=1, fsdp_size=-1, sharding_strategy=strategy
+        )
+    return ParallelismPlugin(sharding_strategy=strategy)
+
+
+def FullyShardedDataParallelPlugin(
+    sharding_strategy: Any = "FULL_SHARD",
+    min_num_params: int = 2**12,
+    cpu_offload: bool = False,
+    **ignored: Any,
+) -> ParallelismPlugin:
+    """FSDP plugin shim (reference utils/dataclasses.py:1075). The torch
+    ShardingStrategy names (or their 1-5 integer codes) map directly."""
+    names = {
+        "FULL_SHARD": ShardingStrategy.FULL_SHARD,
+        "SHARD_GRAD_OP": ShardingStrategy.SHARD_GRAD_OP,
+        "NO_SHARD": ShardingStrategy.NO_SHARD,
+        "HYBRID_SHARD": ShardingStrategy.HYBRID_SHARD,
+        1: ShardingStrategy.FULL_SHARD,
+        2: ShardingStrategy.SHARD_GRAD_OP,
+        3: ShardingStrategy.NO_SHARD,
+        4: ShardingStrategy.HYBRID_SHARD,
+    }
+    if isinstance(sharding_strategy, str):
+        key = sharding_strategy.upper().replace("SHARDINGSTRATEGY.", "")
+    else:
+        key = sharding_strategy
+    if isinstance(key, ShardingStrategy):
+        strategy = key
+    elif key in names:
+        strategy = names[key]
+    else:
+        raise ValueError(f"unknown sharding_strategy {sharding_strategy!r}")
+    if cpu_offload:
+        logger.info(
+            "FullyShardedDataParallelPlugin: cpu_offload maps to the "
+            "big-model host tier (big_modeling.cpu_offload), not the "
+            "train-step plugin"
+        )
+    _warn_ignored("FullyShardedDataParallelPlugin", ignored)
+    if strategy is ShardingStrategy.NO_SHARD:
+        return ParallelismPlugin(
+            sharding_strategy=strategy, min_weight_size=min_num_params
+        )
+    return ParallelismPlugin(
+        dp_size=1,
+        fsdp_size=-1,
+        sharding_strategy=strategy,
+        min_weight_size=min_num_params,
+    )
+
+
+def MegatronLMPlugin(
+    tp_degree: int = 1,
+    pp_degree: int = 1,
+    num_micro_batches: int = 1,
+    sequence_parallelism: bool = False,
+    num_experts: Optional[int] = None,
+    **ignored: Any,
+) -> ParallelismPlugin:
+    """Megatron plugin shim (reference utils/dataclasses.py:1311): tensor/
+    pipeline degrees, microbatches and sequence parallelism carry over;
+    model-definition options (num_layers, hidden_size, ...) belong to
+    TransformerConfig and are ignored here."""
+    if sequence_parallelism:
+        # Megatron SP shards activations across the TP group; the TPU
+        # analogue (ring-attention context parallelism) is its own mesh
+        # axis — opt in with ParallelismPlugin(sp_size=...)
+        logger.info(
+            "MegatronLMPlugin: sequence_parallelism maps to the sp mesh "
+            "axis (ring attention); set ParallelismPlugin.sp_size explicitly"
+        )
+    if num_experts and num_experts > 1:
+        logger.info(
+            "MegatronLMPlugin: expert parallelism is the ep mesh axis; set "
+            "ParallelismPlugin.ep_size to shard experts"
+        )
+    _warn_ignored("MegatronLMPlugin", ignored)
+    return ParallelismPlugin(
+        tp_size=tp_degree,
+        pp_size=pp_degree,
+        num_micro_batches=max(num_micro_batches, pp_degree),
+    )

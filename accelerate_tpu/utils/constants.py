@@ -1,9 +1,9 @@
 """Framework-wide naming and versioning constants.
 
 Parity: reference ``src/accelerate/utils/constants.py`` (MODEL_NAME,
-SAFE_WEIGHTS_NAME, sharding-strategy tables). Here the checkpoint formats are
-TPU-native: Orbax/tensorstore sharded array checkpoints plus msgpack for small
-host-side state.
+SAFE_WEIGHTS_NAME, sharding-strategy tables). Checkpoint formats here:
+safetensors (single-file export and the per-process distributed format of
+``dist_checkpoint.py``) plus json/pickle for small host-side state.
 """
 
 MODEL_NAME = "model"

@@ -291,7 +291,8 @@ class ParallelismPlugin(KwargsHandler):
         # Env fills *defaults* only — an explicitly-passed value wins over
         # the launcher's env transport.
         defaults = {f.name: f.default for f in fields(self.__class__)}
-        for name in ("dp_size", "fsdp_size", "tp_size", "sp_size", "ep_size", "pp_size"):
+        for name in ("dp_size", "fsdp_size", "tp_size", "sp_size", "ep_size",
+                     "pp_size", "num_micro_batches"):
             env = os.environ.get(ENV_PREFIX + name.upper())
             if env is not None and getattr(self, name) == defaults[name]:
                 setattr(self, name, int(env))

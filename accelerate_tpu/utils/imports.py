@@ -120,12 +120,3 @@ def is_pallas_available() -> bool:
         return False
 
 
-@lru_cache
-def is_native_runtime_available() -> bool:
-    """Whether the C++ runtime extension (data pipeline / allocator) built."""
-    try:
-        from accelerate_tpu import _native  # noqa: F401
-
-        return True
-    except Exception:
-        return False

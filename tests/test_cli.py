@@ -136,3 +136,104 @@ def test_in_package_test_script_single_process():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "All checks passed!" in out.stdout
+
+
+def test_interactive_config_questionnaire(tmp_path, monkeypatch):
+    """Scripted stdin drives the full questionnaire (reference
+    tests/test_configs + cluster.py:49). Includes one invalid answer to
+    exercise the re-ask loop."""
+    answers = iter([
+        "0",        # where: LOCAL_MACHINE
+        "1",        # hosts
+        "2",        # mixed precision menu -> fp16
+        "bogus",    # grad accum: invalid, re-asked
+        "4",        # grad accum
+        "8",        # fsdp degree
+        "1",        # sharding strategy menu -> shard_grad_op
+        "2",        # tp
+        "1",        # sp
+        "1",        # ep
+        "2",        # pp
+        "4",        # microbatches
+        "-1",       # dp
+    ])
+    monkeypatch.setattr("builtins.input", lambda prompt="": next(answers))
+    from accelerate_tpu.commands.config import get_user_input
+
+    cfg = get_user_input()
+    assert cfg.compute_environment == "LOCAL_MACHINE"
+    assert cfg.mixed_precision == "fp16"
+    assert cfg.gradient_accumulation_steps == 4
+    assert cfg.fsdp_size == 8 and cfg.sharding_strategy == "shard_grad_op"
+    assert cfg.tp_size == 2 and cfg.pp_size == 2
+    assert cfg.num_micro_batches == 4 and cfg.dp_size == -1
+    path = cfg.save(str(tmp_path / "cfg.yaml"))
+    from accelerate_tpu.commands.config import ClusterConfig
+
+    loaded = ClusterConfig.load(path)
+    assert loaded.pp_size == 2 and loaded.num_micro_batches == 4
+
+
+def test_config_default_flag(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "config", "--default", "--config_file", str(tmp_path / "c.yaml")],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert os.path.isfile(tmp_path / "c.yaml")
+
+
+def test_tpu_config_build_command(tmp_path):
+    """The pod fan-out command line (reference commands/tpu.py:90)."""
+    from accelerate_tpu.commands.tpu import build_pod_command, tpu_command_parser
+
+    parser = tpu_command_parser()
+    args = parser.parse_args([
+        "--tpu_name", "mypod", "--tpu_zone", "us-central2-b",
+        "--command", "echo hi", "--command", "nproc",
+        "--install_accelerate", "--debug",
+    ])
+    cmd = build_pod_command(args)
+    assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh", "mypod"]
+    assert "--worker" in cmd and "all" in cmd
+    joined = cmd[cmd.index("--command") + 1]
+    assert "pip install accelerate_tpu -U" in joined
+    assert "echo hi" in joined and "nproc" in joined
+    assert cmd[-2:] == ["--zone", "us-central2-b"]
+
+
+def test_tpu_config_requires_name_and_command(tmp_path):
+    from accelerate_tpu.commands.tpu import build_pod_command, tpu_command_parser
+
+    parser = tpu_command_parser()
+    args = parser.parse_args(["--command", "echo hi", "--config_file",
+                              str(tmp_path / "missing.yaml")])
+    with pytest.raises(ValueError, match="no TPU name"):
+        build_pod_command(args)
+    args = parser.parse_args(["--tpu_name", "x"])
+    with pytest.raises(ValueError, match="no command"):
+        build_pod_command(args)
+
+
+def test_tpu_config_reads_config_file(tmp_path):
+    from accelerate_tpu.commands.config import ClusterConfig
+    from accelerate_tpu.commands.tpu import build_pod_command, tpu_command_parser
+
+    path = ClusterConfig(tpu_name="podx", tpu_zone="eu-west4-a").save(
+        str(tmp_path / "cfg.yaml")
+    )
+    parser = tpu_command_parser()
+    args = parser.parse_args(
+        ["--config_file", path, "--command", "hostname", "--debug"]
+    )
+    cmd = build_pod_command(args)
+    assert "podx" in cmd and "eu-west4-a" in cmd
+
+
+def test_cli_lists_tpu_config():
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "--help"],
+        capture_output=True, text=True,
+    )
+    assert "tpu-config" in out.stdout

@@ -93,3 +93,59 @@ def test_rejects_indivisible_seq():
     q, k, v = _qkv(S=192)
     with pytest.raises(ValueError):
         flash_attention(q, k, v, block_q=128, block_k=128)
+
+
+def test_causal_q_longer_than_kv_masked_rows_zero_grads():
+    """ADVICE r1: with q_len > kv_len the first q_len-kv_len rows are fully
+    masked; their forward output is zero and their gradients must be zero
+    too (the backward previously fabricated p=1 for them)."""
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(1, 128, 2, 64)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 64, 2, 64)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 64, 2, 64)), jnp.float32)
+    n_masked = 128 - 64
+
+    with _kernel_mode():
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(out[:, :n_masked]), 0.0)
+
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+            return jnp.sum(o ** 2)
+
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    # masked query rows: exactly zero gradient
+    np.testing.assert_allclose(np.asarray(dq[:, :n_masked]), 0.0)
+    assert np.isfinite(np.asarray(dq)).all()
+
+    # valid region must agree with the XLA oracle on the equivalent
+    # end-aligned problem (q2 = last 64 queries, same kv)
+    q2 = q[:, n_masked:]
+
+    def loss_ref(q2, k, v):
+        return jnp.sum(xla_attention(q2, k, v, causal=True) ** 2)
+
+    dq2, dk2, dv2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q2, k, v)
+    np.testing.assert_allclose(
+        np.asarray(dq[:, n_masked:]), np.asarray(dq2), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(dk2), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(dv2), rtol=2e-4, atol=2e-4)
+
+
+def test_forward_masked_rows_inside_visible_block():
+    """When the diagonal crosses mid-block (block_q > kv deficit), fully
+    masked rows share a VISIBLE block with valid rows; their forward output
+    must still be zero, not mean-of-v (review finding on the fwd kernel)."""
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(1, 128, 2, 64)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 64, 2, 64)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 64, 2, 64)), jnp.float32)
+    with _kernel_mode():
+        # block_q=128 covers masked rows 0..63 AND valid rows 64..127
+        out = flash_attention(q, k, v, causal=True, block_q=128, block_k=64)
+    np.testing.assert_allclose(np.asarray(out[:, :64]), 0.0)
+    ref = xla_attention(q[:, 64:], k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out[:, 64:]), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
