@@ -679,6 +679,40 @@ class Accelerator:
         )
 
     @contextmanager
+    def join_uneven_inputs(self, joinables=None, even_batches: Optional[bool] = None):
+        """Train/evaluate on a dataset whose length does not divide the
+        global batch (reference accelerator.py:1072).
+
+        The reference wraps ``torch.distributed.algorithms.join`` so DDP
+        ranks with fewer batches can shadow the stragglers' collectives; in
+        SPMD there are no per-rank collectives to shadow — uneven tails are
+        handled by the samplers (``even_batches`` wraparound, or short-tail
+        padding with remainder tracking). ``joinables`` is accepted for
+        API parity and ignored; ``even_batches`` temporarily overrides the
+        prepared map-style dataloaders' setting, like the reference.
+        """
+        restore: list[tuple[Any, bool]] = []
+        if even_batches is not None:
+            iterable_seen = False
+            for dl in self._dataloaders:
+                shard = getattr(dl, "batch_sampler", None)
+                if shard is None or not hasattr(shard, "even_batches"):
+                    iterable_seen = True
+                    continue
+                restore.append((shard, shard.even_batches))
+                shard.even_batches = even_batches
+            if iterable_seen:
+                logger.warning(
+                    "Overriding even_batches is only supported for "
+                    "map-style datasets; some dataloaders were iterable"
+                )
+        try:
+            yield
+        finally:
+            for shard, prev in restore:
+                shard.even_batches = prev
+
+    @contextmanager
     def autocast(self):
         """Reference :3323. JAX has no ambient autocast; the compute-dtype
         cast happens in the step. Kept as a no-op context for porting."""

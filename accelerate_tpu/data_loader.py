@@ -582,6 +582,7 @@ def prepare_data_loader(
             )
         num_batches = len(factory_shard)
         cls = DataLoaderDispatcher if dispatching else DataLoaderShard
+        batch_sampler = factory_shard
         out = cls(
             factory,
             num_batches,
@@ -591,6 +592,9 @@ def prepare_data_loader(
             sampler=sampler,
             _skip_batches=skip_batches,
         )
+        # exposed for join_uneven_inputs: flipping .even_batches takes
+        # effect on the next epoch's iter(factory_shard)
+        out.batch_sampler = batch_sampler
         return out
 
     # iterable of pre-batched pytrees

@@ -94,14 +94,20 @@ def dot_product_attention(
     if implementation is None:
         # trace-time decision: tracers have no .devices(), so key off the
         # default backend (correct under jit on the target platform)
-        from .flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+        from .flash_attention import (
+            DEFAULT_BLOCK_K,
+            DEFAULT_BLOCK_Q,
+            fit_block,
+        )
 
         on_tpu = jax.default_backend() == "tpu"
         flash_ok = (
             on_tpu and causal and bias is None and mask is None
             and q.shape[1] == k.shape[1] and q.shape[1] >= 256
-            and q.shape[1] % min(DEFAULT_BLOCK_Q, q.shape[1]) == 0
-            and k.shape[1] % min(DEFAULT_BLOCK_K, k.shape[1]) == 0
+            # auto-dispatch stays conservative: lane-aligned seqs only
+            and q.shape[1] % 128 == 0
+            and fit_block(q.shape[1], DEFAULT_BLOCK_Q) is not None
+            and fit_block(k.shape[1], DEFAULT_BLOCK_K) is not None
         )
         implementation = "flash" if flash_ok else "xla"
     if implementation == "xla":

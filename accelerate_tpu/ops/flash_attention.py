@@ -34,11 +34,25 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # Measured on v5e at (B8, S1024, H32/8, D128) fwd+bwd: 1024/1024 runs ~15%
-# faster than 512/512 (fewer grid steps, better MXU occupancy); the wrapper
-# caps blocks to the sequence, so short sequences are unaffected, and the
-# (bq x bk) f32 score tile at 1024^2 (4 MiB) still fits v5e VMEM.
+# faster than 512/512 (fewer grid steps, better MXU occupancy); the
+# (bq x bk) f32 score tile at 1024^2 (4 MiB) still fits v5e VMEM. Sequences
+# not divisible by the preferred block step down via fit_block, so e.g.
+# S=1536 still runs flash at block 512.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
+MIN_BLOCK = 8  # f32 sublane granularity; small blocks run, just slowly
+
+
+def fit_block(seq: int, preferred: int):
+    """Largest block <= preferred that divides ``seq``, halving down to
+    MIN_BLOCK. A sequence that fits entirely (seq <= preferred) is always
+    its own block. None when nothing fits (odd seq > preferred)."""
+    b = min(preferred, seq)
+    while b >= MIN_BLOCK:
+        if seq % b == 0:
+            return b
+        b //= 2
+    return seq if seq <= preferred else None
 NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN from inf-inf
 
 
@@ -357,18 +371,19 @@ def flash_attention(
 ) -> jax.Array:
     """Flash attention, (batch, seq, heads, head_dim) layout, GQA-aware.
 
-    Sequence lengths must be multiples of the block size after capping
-    (the wrapper caps blocks to the sequence length); callers with ragged
-    lengths pad + mask upstream.
+    Blocks adapt downward to divide the sequence (1024 -> 512 -> 256 -> 128
+    steps), so any multiple of 128 works; callers with ragged lengths pad +
+    mask upstream.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     # (B,S,H,D) -> (B,H,S,D)
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
-    bq, bk = min(block_q, qt.shape[2]), min(block_k, kt.shape[2])
-    if qt.shape[2] % bq or kt.shape[2] % bk:
+    bq = fit_block(qt.shape[2], block_q)
+    bk = fit_block(kt.shape[2], block_k)
+    if bq is None or bk is None:
         raise ValueError(
-            f"flash_attention needs seq divisible by block: "
-            f"q seq {qt.shape[2]} % {bq}, kv seq {kt.shape[2]} % {bk}"
+            f"flash_attention needs seq divisible by a block size >= "
+            f"{MIN_BLOCK}: q seq {qt.shape[2]}, kv seq {kt.shape[2]}"
         )
     out = _flash(qt, kt, vt, scale, causal, bq, bk)
     return jnp.swapaxes(out, 1, 2)

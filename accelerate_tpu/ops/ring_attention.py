@@ -153,6 +153,38 @@ def ring_attention(
         if mesh.shape[a] > 1
     ) or None
     heads = MESH_AXIS_TENSOR if mesh.shape[MESH_AXIS_TENSOR] > 1 else None
+
+    # shapes are static under tracing: when they cannot tile the mesh
+    # (model.init probes with (1, tiny_seq); tiny eval batches), run the
+    # dense path instead of failing — checking q AND k/v (GQA kv heads can
+    # be the indivisible ones)
+    import math
+
+    batch_div = math.prod(mesh.shape[a] for a in (batch_axes or ()))
+    heads_div = mesh.shape[heads] if heads else 1
+    sp_div = mesh.shape[axis_name]
+    indivisible = any(
+        x.shape[0] % max(batch_div, 1)
+        or x.shape[1] % sp_div
+        or x.shape[2] % heads_div
+        for x in (q, k, v)
+    )
+    if indivisible:
+        from ..logging import get_logger
+        from .attention import xla_attention
+
+        if q.shape[1] >= 2048:
+            # at long context the dense fallback materializes the O(S^2)
+            # score matrix — the cliff ring attention exists to avoid;
+            # make it visible instead of an opaque OOM later
+            get_logger(__name__).warning(
+                f"ring_attention: shapes q{q.shape}/kv{k.shape} do not "
+                f"tile mesh axes (batch%{batch_div}, seq%{sp_div}, "
+                f"heads%{heads_div}) — falling back to DENSE attention; "
+                "fix batch/seq/head divisibility to keep the ring"
+            )
+        return xla_attention(q, k, v, scale=scale, causal=causal)
+
     spec = P(batch_axes, axis_name, heads, None)
 
     body = functools.partial(

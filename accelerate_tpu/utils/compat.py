@@ -55,12 +55,12 @@ def DeepSpeedPlugin(
     if zero_stage not in _ZERO_TO_STRATEGY:
         raise ValueError(f"zero_stage must be 0-3, got {zero_stage}")
     if gradient_accumulation_steps is not None:
-        import os
-
-        from .constants import ENV_PREFIX
-
-        os.environ[ENV_PREFIX + "GRADIENT_ACCUMULATION_STEPS"] = str(
-            gradient_accumulation_steps
+        # NOT transported via env (a constructor must not mutate process
+        # state); accumulation lives on the Accelerator
+        logger.info(
+            "DeepSpeedPlugin: pass gradient_accumulation_steps="
+            f"{gradient_accumulation_steps} to Accelerator(...) — the "
+            "parallelism plugin only describes sharding"
         )
     _warn_ignored(
         "DeepSpeedPlugin",

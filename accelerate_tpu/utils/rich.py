@@ -1,0 +1,16 @@
+"""Rich console accessor (reference ``utils/rich.py``) — optional pretty
+tracebacks/tables; everything degrades to plain print without rich."""
+
+from __future__ import annotations
+
+from .imports import is_rich_available
+
+
+def get_console():
+    if not is_rich_available():
+        raise ImportError(
+            "accelerate_tpu's rich helpers require rich to be installed"
+        )
+    from rich.console import Console
+
+    return Console()

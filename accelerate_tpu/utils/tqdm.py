@@ -1,0 +1,24 @@
+"""Main-process-gated tqdm wrapper (reference ``utils/tqdm.py``)."""
+
+from __future__ import annotations
+
+from .imports import is_tqdm_available
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """``tqdm.auto.tqdm`` that renders only on the main process by default
+    — every process would otherwise interleave progress bars in a pod job
+    (reference :27)."""
+    if not is_tqdm_available():
+        raise ImportError(
+            "accelerate_tpu's tqdm wrapper requires tqdm to be installed"
+        )
+    from tqdm.auto import tqdm as _tqdm
+
+    if main_process_only:
+        from ..state import PartialState
+
+        kwargs["disable"] = kwargs.get("disable", False) or (
+            not PartialState().is_main_process
+        )
+    return _tqdm(*args, **kwargs)

@@ -1,0 +1,40 @@
+"""Version-comparison helpers (reference ``utils/versions.py``)."""
+
+from __future__ import annotations
+
+import importlib.metadata
+import operator as op
+from typing import Union
+
+from packaging.version import Version, parse
+
+STR_OPERATION_TO_FUNC = {
+    ">": op.gt, ">=": op.ge, "==": op.eq, "!=": op.ne, "<=": op.le, "<": op.lt,
+}
+
+
+def compare_versions(
+    library_or_version: Union[str, Version],
+    operation: str,
+    requirement_version: str,
+) -> bool:
+    """``compare_versions("jax", ">=", "0.6")`` — a library name resolves
+    through importlib.metadata (reference :26)."""
+    if operation not in STR_OPERATION_TO_FUNC:
+        raise ValueError(
+            f"operation must be one of {sorted(STR_OPERATION_TO_FUNC)}, "
+            f"got {operation!r}"
+        )
+    fn = STR_OPERATION_TO_FUNC[operation]
+    if isinstance(library_or_version, str):
+        library_or_version = parse(
+            importlib.metadata.version(library_or_version)
+        )
+    return fn(library_or_version, parse(requirement_version))
+
+
+def is_jax_version(operation: str, version: str) -> bool:
+    """The torch_version helper's TPU analogue (reference :44)."""
+    import jax
+
+    return compare_versions(parse(jax.__version__), operation, version)

@@ -149,3 +149,25 @@ def test_forward_masked_rows_inside_visible_block():
     np.testing.assert_allclose(
         np.asarray(out[:, 64:]), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+def test_fit_block_and_nonpow2_seq():
+    """S=1536 (multiple of 512, not 1024) must still run flash with an
+    adapted block (review finding: raising defaults broke such lengths)."""
+    from accelerate_tpu.ops.flash_attention import MIN_BLOCK, fit_block
+
+    assert fit_block(1536, 1024) == 512
+    assert fit_block(1024, 1024) == 1024
+    assert fit_block(64, 1024) == 64  # short seqs are their own block
+    assert fit_block(192, 128) == 64
+    assert fit_block(128, 64) == 64  # explicit small block still honored
+    assert fit_block(100, 1024) == 100
+    assert fit_block(1001, 512) is None  # odd seq > preferred: no block
+
+    q, k, v = _qkv(S=384)  # 384 = 3*128: needs the adaptive step-down
+    ref = xla_attention(q, k, v, causal=True)
+    with _kernel_mode():
+        out = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )

@@ -122,3 +122,42 @@ def test_pad_input_tensors():
 def test_pad_across_processes_single_noop():
     x = jnp.ones((3, 2))
     assert pad_across_processes(x) is x
+
+
+def test_tqdm_wrapper_main_process_only():
+    from accelerate_tpu.utils.tqdm import tqdm
+
+    bar = tqdm(range(3), main_process_only=True)
+    assert list(bar) == [0, 1, 2]
+
+
+def test_compare_versions():
+    from accelerate_tpu.utils.versions import compare_versions, is_jax_version
+
+    assert compare_versions("jax", ">=", "0.4")
+    assert not compare_versions("jax", "<", "0.4")
+    assert is_jax_version(">", "0.1")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        compare_versions("jax", "~=", "1.0")
+
+
+def test_join_uneven_inputs_overrides_even_batches():
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Accelerator, DataLoader
+
+    class DS:
+        def __len__(self):
+            return 20  # not divisible by global batch
+
+        def __getitem__(self, i):
+            return {"x": jnp.ones((2,)) * i}
+
+    acc = Accelerator()
+    dl = acc.prepare_data_loader(DataLoader(DS(), batch_size=8))
+    assert dl.batch_sampler.even_batches is True
+    with acc.join_uneven_inputs([None], even_batches=False):
+        assert dl.batch_sampler.even_batches is False
+    assert dl.batch_sampler.even_batches is True
