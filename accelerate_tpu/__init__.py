@@ -20,6 +20,7 @@ from .big_modeling import (
     streamed_apply,
 )
 from .data_loader import DataLoader, prepare_data_loader, skip_first_batches
+from .fault_tolerance import CheckpointManager
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import LocalSGD
 from .logging import get_logger
@@ -52,6 +53,7 @@ __all__ = [
     "debug_launcher",
     "notebook_launcher",
     "LocalSGD",
+    "CheckpointManager",
     "find_executable_batch_size",
     "Accelerator",
     "AcceleratedOptimizer",

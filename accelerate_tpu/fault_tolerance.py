@@ -1,0 +1,145 @@
+"""Failure detection & recovery: periodic checkpoints, preemption
+handling, automatic resume.
+
+Parity anchor (SURVEY §5.3): the reference's recovery surface is
+``find_executable_batch_size`` (OOM retry — utils/memory.py here),
+``set_trigger``/``check_trigger`` (accelerator.py) and externally-managed
+restarts (torchrun --max-restarts). TPU-native additions this module owns:
+
+* **preemption**: Cloud TPUs send SIGTERM ahead of maintenance/eviction;
+  the manager catches it and turns the next ``step()`` into a final
+  checkpoint + clean stop, so a preempted job loses at most one step
+  instead of one checkpoint interval.
+* **auto-resume**: the restarted job calls :meth:`restore_or_init` and
+  continues from the latest complete checkpoint — the elastic-restart
+  story on TPU is "rebuild the mesh, reload the shards" (sharded
+  per-process restore via dist_checkpoint), not in-place rank recovery.
+
+Usage::
+
+    manager = CheckpointManager(accelerator, every_n_steps=500)
+    carry, resumed = manager.restore_or_init(carry)
+    for batch in loader:
+        carry, metrics = step(carry, batch)
+        manager.step(carry)
+        if manager.should_stop:
+            break
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Any, Optional, Tuple
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class CheckpointManager:
+    """Periodic + preemption-driven checkpointing with resume.
+
+    ``every_n_steps``: checkpoint cadence in optimizer steps (counted by
+    ``step()`` calls). ``handle_signals``: install a SIGTERM handler (main
+    thread only) that requests a final checkpoint instead of dying
+    mid-write.
+
+    Requires an accelerator configured with
+    ``ProjectConfiguration(automatic_checkpoint_naming=True, project_dir=
+    ...)`` — validated here so the failure is at construction, not at the
+    first (possibly preemption-triggered) save.
+    """
+
+    def __init__(
+        self,
+        accelerator,
+        every_n_steps: int = 500,
+        handle_signals: bool = True,
+    ):
+        if every_n_steps < 1:
+            raise ValueError("every_n_steps must be >= 1")
+        pc = accelerator.project_configuration
+        if not pc.automatic_checkpoint_naming:
+            raise ValueError(
+                "CheckpointManager needs automatic checkpoint naming: "
+                "Accelerator(project_config=ProjectConfiguration("
+                "project_dir=..., automatic_checkpoint_naming=True))"
+            )
+        self.accelerator = accelerator
+        self.every_n_steps = every_n_steps
+        self._count = 0
+        self._preempted = threading.Event()
+        self._preemption_logged = False
+        self._stopped = False
+        self._prev_handlers: dict[int, Any] = {}
+        if handle_signals and threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM,):
+                self._prev_handlers[sig] = signal.signal(
+                    sig, self._on_preemption
+                )
+
+    # ------------------------------------------------------------------ #
+    def _on_preemption(self, signum, frame):
+        # async-signal-safe: ONLY set the flag — logging here can deadlock
+        # on the handler lock if the signal interrupts a logging call
+        self._preempted.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted.is_set()
+
+    @property
+    def should_stop(self) -> bool:
+        """True once a preemption-triggered checkpoint has been written."""
+        return self._stopped
+
+    # ------------------------------------------------------------------ #
+    def restore_or_init(self, carry: Any) -> Tuple[Any, bool]:
+        """Resume from the newest complete checkpoint if one exists, else
+        return ``carry`` unchanged. Call once before the train loop."""
+        pc = self.accelerator.project_configuration
+        base = os.path.join(pc.project_dir or ".", "checkpoints")
+        from .checkpointing import _list_checkpoints
+
+        if not os.path.isdir(base) or not _list_checkpoints(base):
+            return carry, False
+        restored = self.accelerator.load_state(carry=carry)
+        logger.info(
+            f"resumed from step {self.accelerator.step} "
+            f"({_list_checkpoints(base)[-1]})"
+        )
+        return restored, True
+
+    def step(self, carry: Any) -> Optional[str]:
+        """Call once per optimizer step. Saves on the cadence, or
+        immediately when preempted (then flags ``should_stop``). Returns
+        the checkpoint dir when one was written."""
+        self._count += 1
+        preempted = self.preempted
+        if preempted and not self._preemption_logged:
+            self._preemption_logged = True
+            logger.warning(
+                "preemption signal received — writing final checkpoint"
+            )
+        if not preempted and self._count % self.every_n_steps:
+            return None
+        out = self.accelerator.save_state(carry=carry)
+        if preempted:
+            self._stopped = True
+            logger.warning(f"preemption checkpoint written to {out}")
+        return out
+
+    def close(self):
+        """Restore previous signal handlers (tests / nested use)."""
+        for sig, handler in self._prev_handlers.items():
+            signal.signal(sig, handler)
+        self._prev_handlers.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

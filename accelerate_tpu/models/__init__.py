@@ -8,11 +8,13 @@ TP, SP or EP without edits — the whole point of the GSPMD redesign.
 """
 
 from .config import TransformerConfig
+from .seq2seq import Seq2SeqLM
 from .transformer import CausalLM, SequenceClassifier, count_params
 
 __all__ = [
     "TransformerConfig",
     "CausalLM",
     "SequenceClassifier",
+    "Seq2SeqLM",
     "count_params",
 ]

@@ -18,6 +18,8 @@ class TransformerConfig:
     hidden_size: int = 512
     intermediate_size: int = 1408
     num_layers: int = 4
+    # encoder-decoder models (Seq2SeqLM): decoder depth; None -> num_layers
+    num_decoder_layers: Optional[int] = None
     num_heads: int = 8
     num_kv_heads: Optional[int] = None  # None -> num_heads (MHA); < heads -> GQA
     head_dim: Optional[int] = None  # None -> hidden_size // num_heads
@@ -113,6 +115,21 @@ class TransformerConfig:
         kw.setdefault("num_heads", 64)
         kw.setdefault("num_kv_heads", 8)
         kw.setdefault("max_seq_len", 8192)
+        return cls(**kw)
+
+    @classmethod
+    def t5_base(cls, **kw) -> "TransformerConfig":
+        """T5-base shape family (reference megatron t5 parser
+        utils/megatron_lm.py:1717): 12+12 layers, 768 hidden. SwiGLU/rope
+        replace relu/relative-bias — capability parity, modernized arch."""
+        kw.setdefault("vocab_size", 32128)
+        kw.setdefault("hidden_size", 768)
+        kw.setdefault("intermediate_size", 2048)
+        kw.setdefault("num_layers", 12)
+        kw.setdefault("num_decoder_layers", 12)
+        kw.setdefault("num_heads", 12)
+        kw.setdefault("max_seq_len", 512)
+        kw.setdefault("tie_embeddings", True)
         return cls(**kw)
 
     @classmethod

@@ -1,0 +1,218 @@
+"""Encoder-decoder (T5/BART-family) model — completes the reference's
+bert/gpt/t5 family coverage (reference utils/megatron_lm.py:1641-1771
+parses exactly these three into Megatron args; SURVEY §2.4).
+
+Same TPU-native construction as the decoder-only stack: logical-axis
+partitioned params, ``nn.scan`` over layers, optional remat, attention via
+:mod:`..ops.attention`. The decoder block adds cross-attention (queries
+from the decoder stream, keys/values from the encoder memory — no rope on
+the cross path; each stream already carries its own positions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from .config import TransformerConfig
+from .transformer import (
+    MLP,
+    Attention,
+    RMSNorm,
+    _apply_layer_stack,
+    _dtype,
+    _make_embed,
+    _make_proj,
+)
+
+
+class CrossAttention(nn.Module):
+    """Decoder-to-encoder attention: q from ``x``, k/v from ``memory``."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, memory, memory_mask=None):
+        cfg = self.config
+        dtype = _dtype(cfg)
+        proj = _make_proj(cfg, dtype)
+        q_dim = cfg.num_heads * cfg.head_dim
+        kv_dim = cfg.num_kv_heads * cfg.head_dim
+
+        b, s = x.shape[:2]
+        sm = memory.shape[1]
+        q = proj("q_proj", q_dim, ("embed", "heads"))(x)
+        k = proj("k_proj", kv_dim, ("embed", "kv"))(memory)
+        v = proj("v_proj", kv_dim, ("embed", "kv"))(memory)
+        q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, sm, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(b, sm, cfg.num_kv_heads, cfg.head_dim)
+        mask = None
+        if memory_mask is not None:  # (B, Sm) source padding -> (B,1,1,Sm)
+            mask = memory_mask[:, None, None, :].astype(bool)
+        # xla forced: flash supports only causal/no-mask, and ring needs
+        # BOTH streams sp-sharded with equal lengths — neither holds for
+        # the rectangular (S_dec x S_enc) cross pattern
+        out = dot_product_attention(
+            q, k, v, mask=mask, causal=False, implementation="xla"
+        )
+        out = out.reshape(b, s, q_dim)
+        return proj("o_proj", cfg.hidden_size, ("heads", "embed"))(out)
+
+
+class DecoderBlock(nn.Module):
+    """Self-attention (causal) + cross-attention + MLP, pre-norm."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, memory, memory_mask=None):
+        cfg = self.config
+        h = x + Attention(cfg, name="self_attn")(
+            RMSNorm(cfg, name="self_attn_norm")(x), positions, None
+        )
+        h = h + CrossAttention(cfg, name="cross_attn")(
+            RMSNorm(cfg, name="cross_attn_norm")(h), memory, memory_mask
+        )
+        return h + MLP(cfg, name="mlp")(RMSNorm(cfg, name="mlp_norm")(h)), None
+
+
+class _Encoder(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, mask=None):
+        return _apply_layer_stack(self.config, x, positions, mask)
+
+
+class _Decoder(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, memory, memory_mask=None):
+        cfg = self.config
+        return _apply_layer_stack(
+            cfg, x, positions, memory, memory_mask,
+            block_cls=DecoderBlock,
+            num_layers=cfg.num_decoder_layers or cfg.num_layers,
+        )
+
+
+class Seq2SeqLM(nn.Module):
+    """Encoder-decoder LM: shared embedding, bidirectional encoder, causal
+    decoder with cross-attention, tied (or separate) lm head.
+
+    ``__call__(input_ids, decoder_input_ids, attention_mask=None) ->
+    logits`` over the decoder positions (teacher forcing).
+    """
+
+    config: TransformerConfig
+
+    def _encoder_config(self) -> TransformerConfig:
+        return dataclasses.replace(self.config, causal=False)
+
+    def _decoder_config(self) -> TransformerConfig:
+        # forced regardless of what the user's config says: a non-causal
+        # decoder would leak future target tokens through teacher forcing
+        return dataclasses.replace(self.config, causal=True)
+
+    @nn.compact
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
+        cfg = self.config
+        dtype = _dtype(cfg)
+        embed = _make_embed(cfg, dtype)
+
+        # --- encoder ---
+        enc_pos = jnp.broadcast_to(
+            jnp.arange(input_ids.shape[1])[None, :], input_ids.shape
+        )
+        enc_mask = None
+        if attention_mask is not None:  # (B, Sm) -> (B,1,1,Sm)
+            enc_mask = attention_mask[:, None, None, :].astype(bool)
+        memory = _Encoder(self._encoder_config(), name="encoder")(
+            embed(input_ids), enc_pos, enc_mask
+        )
+        memory = RMSNorm(cfg, name="encoder_norm")(memory)
+
+        # --- decoder ---
+        dec_pos = jnp.broadcast_to(
+            jnp.arange(decoder_input_ids.shape[1])[None, :],
+            decoder_input_ids.shape,
+        )
+        x = _Decoder(self._decoder_config(), name="decoder")(
+            embed(decoder_input_ids), dec_pos, memory, attention_mask
+        )
+        x = RMSNorm(cfg, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            return embed.attend(x)
+        return nn.Dense(
+            cfg.vocab_size,
+            use_bias=False,
+            dtype=dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            name="lm_head",
+        )(x)
+
+    # ------------------------------------------------------------------ #
+    def init_params(self, rng, batch_size: int = 1, seq_len: int = 16):
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self.init(rng, dummy, dummy)["params"]
+
+    @staticmethod
+    def loss_fn(model: "Seq2SeqLM"):
+        """Teacher-forced cross-entropy. Batch keys: ``input_ids``,
+        ``decoder_input_ids``, ``labels``, optional ``attention_mask``
+        (source padding) and ``decoder_loss_mask``."""
+
+        def fn(params, batch):
+            logits = model.apply(
+                {"params": params},
+                batch["input_ids"],
+                batch["decoder_input_ids"],
+                batch.get("attention_mask"),
+            )
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, batch["labels"][..., None], axis=-1
+            )[..., 0]
+            mask = batch.get("decoder_loss_mask")
+            if mask is not None:
+                mask = mask.astype(jnp.float32)
+                return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            return jnp.mean(nll)
+
+        return fn
+
+    def generate(
+        self,
+        params: Any,
+        input_ids: jax.Array,
+        max_new_tokens: int = 32,
+        bos_token_id: int = 0,
+        eos_token_id: Optional[int] = None,
+        attention_mask: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Greedy decode (full-recompute per step: O(L^2) — correct and
+        simple; KV-cached seq2seq decode mirrors the CausalLM cache and is
+        a planned optimization)."""
+        B = input_ids.shape[0]
+        dec = jnp.full((B, 1), bos_token_id, jnp.int32)
+        done = jnp.zeros((B,), bool)
+        for _ in range(max_new_tokens):
+            logits = self.apply(
+                {"params": params}, input_ids, dec, attention_mask
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            if eos_token_id is not None:
+                nxt = jnp.where(done, eos_token_id, nxt)
+                done = done | (nxt == eos_token_id)
+            dec = jnp.concatenate([dec, nxt[:, None]], axis=1)
+        return dec

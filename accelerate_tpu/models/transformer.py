@@ -312,41 +312,54 @@ def _make_embed(cfg: TransformerConfig, dtype) -> nn.Embed:
     )
 
 
-def _apply_layer_stack(cfg: TransformerConfig, x, positions, mask=None,
-                       decode=False):
-    """Run the block stack (scan or unrolled, optional remat) on hidden
+_REMAT_POLICIES = {
+    "full": lambda: None,
+    "dots": lambda: jax.checkpoint_policies.checkpoint_dots,
+    "dots_with_no_batch_dims": (
+        lambda: jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    ),
+    "save_attn": lambda: jax.checkpoint_policies.save_only_these_names(
+        "attn_out"
+    ),
+}
+
+
+def _apply_layer_stack(cfg: TransformerConfig, x, *extra, decode=False,
+                       block_cls=None, num_layers=None):
+    """Run a block stack (scan or unrolled, optional remat) on hidden
     states. Must be called inside an ``nn.compact`` context — the created
-    modules attach to the calling module's scope, so CausalLM and
-    SequenceClassifier share one implementation and one param layout."""
-    block_cls = Block
+    modules attach to the calling module's scope, so CausalLM,
+    SequenceClassifier and the seq2seq decoder share one implementation.
+
+    ``extra``: per-call broadcast arguments of the block (positions, mask,
+    memory, ...). ``block_cls``: defaults to :class:`Block`; the seq2seq
+    decoder passes :class:`~.seq2seq.DecoderBlock`. Blocks must return
+    ``(x, None)``.
+    """
+    base_cls = block_cls or Block
+    block_kwargs = {"decode": decode} if block_cls is None else {}
+    cls = base_cls
     if cfg.remat:
-        policy = {
-            "full": None,
-            "dots": jax.checkpoint_policies.checkpoint_dots,
-            "dots_with_no_batch_dims": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-            "save_attn": jax.checkpoint_policies.save_only_these_names(
-                "attn_out"
-            ),
-        }[cfg.remat]
-        block_cls = nn.remat(
-            Block, policy=policy, prevent_cse=not cfg.scan_layers,
+        cls = nn.remat(
+            base_cls,
+            policy=_REMAT_POLICIES[cfg.remat](),
+            prevent_cse=not cfg.scan_layers,
             static_argnums=(),
         )
+    n = num_layers or cfg.num_layers
 
     if cfg.scan_layers:
         x, _ = nn.scan(
-            block_cls,
+            cls,
             variable_axes={"params": 0, "intermediates": 0, "cache": 0},
             split_rngs={"params": True},
-            in_axes=(nn.broadcast, nn.broadcast),
-            length=cfg.num_layers,
+            in_axes=tuple(nn.broadcast for _ in extra),
+            length=n,
             metadata_params={nn.PARTITION_NAME: "layers"},
-        )(cfg, decode=decode, name="layers")(x, positions, mask)
+        )(cfg, **block_kwargs, name="layers")(x, *extra)
     else:
-        for i in range(cfg.num_layers):
-            x, _ = block_cls(cfg, decode=decode, name=f"layer_{i}")(
-                x, positions, mask
-            )
+        for i in range(n):
+            x, _ = cls(cfg, **block_kwargs, name=f"layer_{i}")(x, *extra)
     return x
 
 

@@ -1,0 +1,101 @@
+"""Big-model inference: load a checkpoint that does not fit one device.
+
+TPU-native counterpart of reference ``benchmarks/big_model_inference.py`` /
+the ``device_map="auto"`` flow (``load_checkpoint_and_dispatch``,
+big_modeling.py:499): abstract-init the model (zero allocation), stream the
+checkpoint into a tiered placement (device / host / disk), and generate.
+
+Two placement modes, both demonstrated:
+  - GSPMD: shard every weight over the mesh (the real multi-chip answer);
+  - device_map: reference-style tiers incl. an executable disk tier
+    (weights materialize lazily from memmaps).
+
+Hub-free: a synthetic checkpoint is written locally first. Run:
+
+    python examples/big_model_inference.py [--max_memory_mb 1] [--seq 32]
+"""
+
+import argparse
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from accelerate_tpu import (
+    Accelerator,
+    ParallelismPlugin,
+    dispatch_params,
+    infer_auto_device_map,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+    materialize_offloaded,
+)
+from accelerate_tpu.checkpointing import save_model_weights
+from accelerate_tpu.models import CausalLM, TransformerConfig
+from accelerate_tpu.models.generation import generate
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seq", type=int, default=16)
+    parser.add_argument("--new_tokens", type=int, default=8)
+    parser.add_argument(
+        "--max_memory_mb", type=float, default=None,
+        help="Artificially cap device memory to force cpu/disk spill",
+    )
+    args = parser.parse_args()
+
+    cfg = TransformerConfig.tiny(max_seq_len=128)
+    model = CausalLM(cfg)
+
+    workdir = tempfile.mkdtemp(prefix="big_model_")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    offload_dir = os.path.join(workdir, "offload")
+
+    # --- someone trained a model and saved sharded weights ---
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    save_model_weights(params, ckpt_dir, max_shard_size="2MB")
+    print(f"checkpoint written to {ckpt_dir}")
+
+    # --- abstract init: the full tree as shapes, zero bytes allocated ---
+    abstract = init_empty_weights(
+        model.init, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    acc = Accelerator(parallelism_plugin=ParallelismPlugin())
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (1, args.seq)),
+        jnp.int32,
+    )
+
+    # mode 1: GSPMD — stream shards straight onto mesh shardings
+    loaded = load_checkpoint_and_dispatch(
+        abstract, ckpt_dir, mesh=acc.mesh,
+        plugin=acc.state.parallelism_plugin,
+    )
+    out = generate(model, loaded, prompt, max_new_tokens=args.new_tokens)
+    print("GSPMD generate:", np.asarray(out)[0, -args.new_tokens:].tolist())
+
+    # mode 2: device_map tiers (cap memory to force cpu/disk spill)
+    max_memory = None
+    if args.max_memory_mb is not None:
+        max_memory = {0: int(args.max_memory_mb * 2**20), "cpu": 8 << 20}
+    device_map = infer_auto_device_map(abstract, max_memory)
+    tiers = sorted({str(v) for v in device_map.values()})
+    print(f"device_map tiers in use: {tiers}")
+    placed = load_checkpoint_and_dispatch(
+        abstract, ckpt_dir, device_map=device_map, offload_dir=offload_dir,
+    )
+    live = materialize_offloaded(placed)
+    out2 = generate(model, live, prompt, max_new_tokens=args.new_tokens)
+    print("tiered generate:", np.asarray(out2)[0, -args.new_tokens:].tolist())
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    print("outputs identical across placements — big-model inference OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -189,3 +189,20 @@ def test_early_stopping_example():
 def test_memory_example():
     metric = _run_example("memory", ["--cpu"], env={"TESTING_NUM_EPOCHS": "1"})
     assert "accuracy" in metric
+
+
+@pytest.mark.slow
+def test_big_model_inference_example():
+    """Tiered big-model loading ends in identical generations across GSPMD
+    and device_map placements (the example asserts it internally)."""
+    import runpy
+
+    old_argv = sys.argv
+    sys.argv = ["big_model_inference.py", "--max_memory_mb", "0.5",
+                "--new_tokens", "4"]
+    try:
+        runpy.run_path(
+            str(EXAMPLES / "big_model_inference.py"), run_name="__main__"
+        )
+    finally:
+        sys.argv = old_argv
