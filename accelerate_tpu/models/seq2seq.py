@@ -72,6 +72,8 @@ class DecoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, memory, memory_mask=None):
+        from ..parallel.sharding import constrain_activations
+
         cfg = self.config
         h = x + Attention(cfg, name="self_attn")(
             RMSNorm(cfg, name="self_attn_norm")(x), positions, None
@@ -79,7 +81,10 @@ class DecoderBlock(nn.Module):
         h = h + CrossAttention(cfg, name="cross_attn")(
             RMSNorm(cfg, name="cross_attn_norm")(h), memory, memory_mask
         )
-        return h + MLP(cfg, name="mlp")(RMSNorm(cfg, name="mlp_norm")(h)), None
+        # per-layer layout pin, same rationale as transformer.Block
+        return constrain_activations(
+            h + MLP(cfg, name="mlp")(RMSNorm(cfg, name="mlp_norm")(h))
+        ), None
 
 
 class _Encoder(nn.Module):

@@ -291,12 +291,19 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, mask=None):
+        from ..parallel.sharding import constrain_activations
+
         cfg = self.config
         h = x + Attention(cfg, decode=self.decode, name="attn")(
             RMSNorm(cfg, name="attn_norm")(x), positions, mask
         )
         ff = MoE(cfg, name="moe") if cfg.num_experts > 0 else MLP(cfg, name="mlp")
-        return h + ff(RMSNorm(cfg, name="mlp_norm")(h)), None
+        # pin the residual stream's layout once per layer so GSPMD cannot
+        # alternate it between batch-sharded and weight-following layouts
+        # (each flip is a full resharding per layer)
+        return constrain_activations(
+            h + ff(RMSNorm(cfg, name="mlp_norm")(h))
+        ), None
 
 
 def _make_embed(cfg: TransformerConfig, dtype) -> nn.Embed:
@@ -379,10 +386,12 @@ class CausalLM(nn.Module):
             positions = jnp.broadcast_to(
                 jnp.arange(input_ids.shape[1])[None, :], input_ids.shape
             )
+        from ..parallel.sharding import constrain_activations
+
         embed = _make_embed(cfg, dtype)
-        x = embed(input_ids)
+        x = constrain_activations(embed(input_ids))
         x = _apply_layer_stack(cfg, x, positions, mask, decode=decode)
-        x = RMSNorm(cfg, name="final_norm")(x)
+        x = constrain_activations(RMSNorm(cfg, name="final_norm")(x))
         # logits matmul stays in the compute dtype (bf16 on the MXU — fp32
         # here costs ~4x on the biggest matmul); the loss upcasts to fp32
         # before log_softmax, which is where precision actually matters

@@ -90,8 +90,9 @@ def moe_dispatch_combine(
         .set(x[tok_idx], mode="drop")
         .reshape(E, C, h)
     )
+    buf = _constrain_expert_buffer(buf)
 
-    expert_out = experts_fn(buf)  # (E, C, h)
+    expert_out = _constrain_expert_buffer(experts_fn(buf))  # (E, C, h)
 
     y = jnp.take(
         expert_out.reshape(E * C, h), slot, axis=0,
@@ -99,6 +100,33 @@ def moe_dispatch_combine(
     )  # (TK, h); dropped choices read zeros
     y = y.reshape(T, K, h) * weights.reshape(T, K, 1).astype(y.dtype)
     return jnp.sum(y, axis=1)
+
+
+def _constrain_expert_buffer(buf: jax.Array) -> jax.Array:
+    """Pin the (E, C, h) buffer: experts over ep, capacity over the
+    remaining data axes — so GSPMD lowers dispatch/combine to one
+    all-to-all instead of flip-flopping the buffer between token- and
+    expert-sharded layouts, AND the expert einsums stay divided across
+    dp/fsdp instead of replicated (every dp replica computing all C slots
+    would multiply the expert FLOPs). No-op without a live mesh or ep==1."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.sharding import live_mesh
+    from ..utils.constants import MESH_AXIS_DATA, MESH_AXIS_EXPERT, MESH_AXIS_FSDP
+
+    mesh = live_mesh()
+    if mesh is None or mesh.shape.get(MESH_AXIS_EXPERT, 1) <= 1:
+        return buf
+    if buf.shape[0] % mesh.shape[MESH_AXIS_EXPERT]:
+        return buf
+    cap_axes = tuple(
+        a for a in (MESH_AXIS_DATA, MESH_AXIS_FSDP) if mesh.shape[a] > 1
+    )
+    cap_div = math.prod(mesh.shape[a] for a in cap_axes)
+    spec_c = cap_axes if cap_axes and buf.shape[1] % cap_div == 0 else None
+    return jax.lax.with_sharding_constraint(
+        buf, NamedSharding(mesh, P(MESH_AXIS_EXPERT, spec_c, None))
+    )
 
 
 def load_balancing_loss(

@@ -87,6 +87,51 @@ def batch_sharding(mesh: Mesh, *, seq_dim: Optional[int] = None) -> NamedShardin
     return NamedSharding(mesh, P(*spec))
 
 
+def live_mesh() -> Optional[Mesh]:
+    """The AcceleratorState's mesh when one is initialized and non-trivial,
+    else None — the shared guard for trace-time sharding constraints."""
+    from ..state import AcceleratorState
+
+    if not AcceleratorState._shared_state:
+        return None
+    mesh = AcceleratorState().mesh
+    if mesh is None or mesh.devices.size == 1:
+        return None
+    return mesh
+
+
+def constrain_activations(x, seq_dim: Optional[int] = 1):
+    """Pin a (B, S, H) activation to the canonical layout: batch over the
+    data axes, sequence over sp, hidden replicated (tp lives in the
+    weights; activations between blocks stay hidden-replicated, the
+    Megatron layout).
+
+    Without the pin, GSPMD propagation can alternate an activation between
+    the batch-sharded layout (from the inputs) and a weight-following
+    layout (e.g. the tied-embedding logits matmul pulling hidden onto
+    fsdp), producing "involuntary full rematerialization" resharding on
+    every layer boundary. No-op when no AcceleratorState is live or the
+    mesh is trivial.
+    """
+    mesh = live_mesh()
+    if mesh is None:
+        return x
+    import math
+
+    axes = data_axes(mesh)
+    if x.shape[0] % math.prod(mesh.shape[a] for a in axes):
+        return x  # probe shapes (init at batch 1) can't tile the data axes
+    spec: list[Any] = [axes] + [None] * (x.ndim - 1)
+    if (
+        seq_dim is not None
+        and seq_dim < x.ndim
+        and mesh.shape[MESH_AXIS_SEQUENCE] > 1
+        and x.shape[seq_dim] % mesh.shape[MESH_AXIS_SEQUENCE] == 0
+    ):
+        spec[seq_dim] = MESH_AXIS_SEQUENCE
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
 def _fsdp_spec_for_leaf(
     arr: Any, fsdp_size: int, min_weight_size: int
 ) -> P:
