@@ -42,6 +42,17 @@ def _sample_logits(logits, key, temperature, top_k, top_p):
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def init_cache(model_init, *init_args, **init_kwargs):
+    """Zeroed decode-cache template via eval_shape: a full ``model.init``
+    here would materialize (and randomly initialize) an entire spare
+    parameter tree just to learn the cache shapes — pure HBM/time waste at
+    8B+ scale. Shared by CausalLM and Seq2SeqLM generation."""
+    cache_shapes = jax.eval_shape(
+        lambda: model_init(*init_args, **init_kwargs)["cache"]
+    )
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+
 def generate(
     model: CausalLM,
     params: Any,
@@ -65,16 +76,9 @@ def generate(
             f"exceeds max_seq_len ({model.config.max_seq_len})"
         )
     key = key if key is not None else jax.random.PRNGKey(0)
-    # cache template via eval_shape + zeros: a full model.init here would
-    # materialize (and randomly initialize) an entire spare parameter tree
-    # just to learn the cache shapes — pure HBM/time waste at 8B+ scale
-    cache_shapes = jax.eval_shape(
-        lambda: model.init(
-            jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32), decode=True
-        )["cache"]
-    )
-    cache = jax.tree.map(
-        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    cache = init_cache(
+        model.init, jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32),
+        decode=True,
     )
 
     # prefill the whole prompt in one forward

@@ -32,9 +32,16 @@ from .transformer import (
 
 
 class CrossAttention(nn.Module):
-    """Decoder-to-encoder attention: q from ``x``, k/v from ``memory``."""
+    """Decoder-to-encoder attention: q from ``x``, k/v from ``memory``.
+
+    Under ``decode`` the memory K/V projections are computed once (first
+    step) and cached — they never change during generation, and
+    recomputing 2 x (S_src, h, kv) matmuls per layer per token would eat
+    the KV-cache win.
+    """
 
     config: TransformerConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, memory, memory_mask=None):
@@ -47,11 +54,39 @@ class CrossAttention(nn.Module):
         b, s = x.shape[:2]
         sm = memory.shape[1]
         q = proj("q_proj", q_dim, ("embed", "heads"))(x)
-        k = proj("k_proj", kv_dim, ("embed", "kv"))(memory)
-        v = proj("v_proj", kv_dim, ("embed", "kv"))(memory)
+        k_proj = proj("k_proj", kv_dim, ("embed", "kv"))
+        v_proj = proj("v_proj", kv_dim, ("embed", "kv"))
+
+        def compute_kv():
+            k = k_proj(memory).reshape(b, sm, cfg.num_kv_heads, cfg.head_dim)
+            v = v_proj(memory).reshape(b, sm, cfg.num_kv_heads, cfg.head_dim)
+            return k, v
+
+        if self.decode:
+            is_init = self.has_variable("cache", "cross_key")
+            kv_shape = (b, sm, cfg.num_kv_heads, cfg.head_dim)
+            ck = self.variable(
+                "cache", "cross_key", lambda: jnp.zeros(kv_shape, dtype)
+            )
+            cv = self.variable(
+                "cache", "cross_value", lambda: jnp.zeros(kv_shape, dtype)
+            )
+            filled = self.variable(
+                "cache", "cross_filled", lambda: jnp.zeros((), bool)
+            )
+            if not is_init:  # init pass: run the projs so params exist
+                k, v = compute_kv()
+            else:
+                k, v = jax.lax.cond(
+                    filled.value,
+                    lambda: (ck.value, cv.value),
+                    compute_kv,
+                )
+                ck.value, cv.value = k, v
+                filled.value = jnp.ones((), bool)
+        else:
+            k, v = compute_kv()
         q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
-        k = k.reshape(b, sm, cfg.num_kv_heads, cfg.head_dim)
-        v = v.reshape(b, sm, cfg.num_kv_heads, cfg.head_dim)
         mask = None
         if memory_mask is not None:  # (B, Sm) source padding -> (B,1,1,Sm)
             mask = memory_mask[:, None, None, :].astype(bool)
@@ -66,19 +101,21 @@ class CrossAttention(nn.Module):
 
 
 class DecoderBlock(nn.Module):
-    """Self-attention (causal) + cross-attention + MLP, pre-norm."""
+    """Self-attention (causal, KV-cached under ``decode``) +
+    cross-attention + MLP, pre-norm."""
 
     config: TransformerConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions, memory, memory_mask=None):
         from ..parallel.sharding import constrain_activations
 
         cfg = self.config
-        h = x + Attention(cfg, name="self_attn")(
+        h = x + Attention(cfg, decode=self.decode, name="self_attn")(
             RMSNorm(cfg, name="self_attn_norm")(x), positions, None
         )
-        h = h + CrossAttention(cfg, name="cross_attn")(
+        h = h + CrossAttention(cfg, decode=self.decode, name="cross_attn")(
             RMSNorm(cfg, name="cross_attn_norm")(h), memory, memory_mask
         )
         # per-layer layout pin, same rationale as transformer.Block
@@ -99,10 +136,11 @@ class _Decoder(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, memory, memory_mask=None):
+    def __call__(self, x, positions, memory, memory_mask=None, decode=False):
         cfg = self.config
         return _apply_layer_stack(
             cfg, x, positions, memory, memory_mask,
+            decode=decode,
             block_cls=DecoderBlock,
             num_layers=cfg.num_decoder_layers or cfg.num_layers,
         )
@@ -113,58 +151,71 @@ class Seq2SeqLM(nn.Module):
     decoder with cross-attention, tied (or separate) lm head.
 
     ``__call__(input_ids, decoder_input_ids, attention_mask=None) ->
-    logits`` over the decoder positions (teacher forcing).
+    logits`` over the decoder positions (teacher forcing). ``encode`` /
+    ``decode_logits`` are exposed separately so generation encodes the
+    source ONCE and steps the decoder with a KV cache.
     """
 
     config: TransformerConfig
 
-    def _encoder_config(self) -> TransformerConfig:
-        return dataclasses.replace(self.config, causal=False)
-
-    def _decoder_config(self) -> TransformerConfig:
-        # forced regardless of what the user's config says: a non-causal
-        # decoder would leak future target tokens through teacher forcing
-        return dataclasses.replace(self.config, causal=True)
-
-    @nn.compact
-    def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
+    def setup(self):
         cfg = self.config
         dtype = _dtype(cfg)
-        embed = _make_embed(cfg, dtype)
+        self.embed = _make_embed(cfg, dtype, name=None)
+        self.encoder = _Encoder(dataclasses.replace(cfg, causal=False))
+        self.encoder_norm = RMSNorm(cfg)
+        # causal forced regardless of the user config: a non-causal decoder
+        # would leak future target tokens through teacher forcing
+        self.decoder = _Decoder(dataclasses.replace(cfg, causal=True))
+        self.final_norm = RMSNorm(cfg)
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Dense(
+                cfg.vocab_size,
+                use_bias=False,
+                dtype=dtype,
+                param_dtype=jnp.float32,
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "vocab")
+                ),
+            )
 
-        # --- encoder ---
+    # ------------------------------------------------------------------ #
+    def encode(self, input_ids, attention_mask=None):
+        """Source -> memory; run ONCE per generation."""
         enc_pos = jnp.broadcast_to(
             jnp.arange(input_ids.shape[1])[None, :], input_ids.shape
         )
         enc_mask = None
         if attention_mask is not None:  # (B, Sm) -> (B,1,1,Sm)
             enc_mask = attention_mask[:, None, None, :].astype(bool)
-        memory = _Encoder(self._encoder_config(), name="encoder")(
-            embed(input_ids), enc_pos, enc_mask
-        )
-        memory = RMSNorm(cfg, name="encoder_norm")(memory)
+        memory = self.encoder(self.embed(input_ids), enc_pos, enc_mask)
+        return self.encoder_norm(memory)
 
-        # --- decoder ---
+    def decode_logits(
+        self, decoder_input_ids, memory, attention_mask=None, decode=False
+    ):
+        """Decoder forward over (possibly incremental) target tokens.
+        ``decode=True`` uses the per-layer KV cache (mutable="cache")."""
         dec_pos = jnp.broadcast_to(
             jnp.arange(decoder_input_ids.shape[1])[None, :],
             decoder_input_ids.shape,
         )
-        x = _Decoder(self._decoder_config(), name="decoder")(
-            embed(decoder_input_ids), dec_pos, memory, attention_mask
+        x = self.decoder(
+            self.embed(decoder_input_ids), dec_pos, memory, attention_mask,
+            decode=decode,
         )
-        x = RMSNorm(cfg, name="final_norm")(x)
-        if cfg.tie_embeddings:
-            return embed.attend(x)
-        return nn.Dense(
-            cfg.vocab_size,
-            use_bias=False,
-            dtype=dtype,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_partitioning(
-                nn.initializers.lecun_normal(), ("embed", "vocab")
-            ),
-            name="lm_head",
-        )(x)
+        x = self.final_norm(x)
+        if self.config.tie_embeddings:
+            return self.embed.attend(x)
+        return self.lm_head(x)
+
+    def __call__(
+        self, input_ids, decoder_input_ids, attention_mask=None, decode=False
+    ):
+        memory = self.encode(input_ids, attention_mask)
+        return self.decode_logits(
+            decoder_input_ids, memory, attention_mask, decode=decode
+        )
 
     # ------------------------------------------------------------------ #
     def init_params(self, rng, batch_size: int = 1, seq_len: int = 16):
@@ -205,19 +256,54 @@ class Seq2SeqLM(nn.Module):
         eos_token_id: Optional[int] = None,
         attention_mask: Optional[jax.Array] = None,
     ) -> jax.Array:
-        """Greedy decode (full-recompute per step: O(L^2) — correct and
-        simple; KV-cached seq2seq decode mirrors the CausalLM cache and is
-        a planned optimization)."""
+        """Greedy decode with KV caches (self-attention keys/values AND the
+        cross-attention memory projections, computed once) — O(L) per token
+        instead of the full-recompute O(L^2). One ``lax.scan`` program, so
+        it jits whole."""
+        from .generation import init_cache
+
         B = input_ids.shape[0]
-        dec = jnp.full((B, 1), bos_token_id, jnp.int32)
-        done = jnp.zeros((B,), bool)
-        for _ in range(max_new_tokens):
-            logits = self.apply(
-                {"params": params}, input_ids, dec, attention_mask
+        if max_new_tokens + 1 > self.config.max_seq_len:
+            raise ValueError(
+                f"max_new_tokens ({max_new_tokens}) + bos exceeds the "
+                f"decoder cache length (max_seq_len={self.config.max_seq_len})"
+            )
+        bos = jnp.full((B, 1), bos_token_id, jnp.int32)
+        if max_new_tokens <= 0:
+            return bos
+        memory = self.apply(
+            {"params": params}, input_ids, attention_mask,
+            method=Seq2SeqLM.encode,
+        )
+        # cache template at the REAL source length (the cross-KV cache
+        # shape depends on it), no spare param materialization
+        cache = init_cache(
+            self.init,
+            jax.random.PRNGKey(0),
+            jnp.zeros_like(input_ids),
+            jnp.zeros((B, 1), jnp.int32),
+            decode=True,
+        )
+
+        def step(carry, _):
+            cache, tok, done = carry
+            logits, mutated = self.apply(
+                {"params": params, "cache": cache},
+                tok[:, None],
+                memory,
+                attention_mask,
+                decode=True,
+                mutable=["cache"],
+                method=Seq2SeqLM.decode_logits,
             )
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
             if eos_token_id is not None:
                 nxt = jnp.where(done, eos_token_id, nxt)
                 done = done | (nxt == eos_token_id)
-            dec = jnp.concatenate([dec, nxt[:, None]], axis=1)
-        return dec
+            return (mutated["cache"], nxt, done), nxt
+
+        done0 = jnp.zeros((B,), bool)
+        (_, _, _), toks = jax.lax.scan(
+            step, (cache, bos[:, 0], done0), None, length=max_new_tokens
+        )
+        return jnp.concatenate([bos, toks.T], axis=1)

@@ -306,7 +306,8 @@ class Block(nn.Module):
         ), None
 
 
-def _make_embed(cfg: TransformerConfig, dtype) -> nn.Embed:
+def _make_embed(cfg: TransformerConfig, dtype, name: Optional[str] = "embed") -> nn.Embed:
+    kw = {"name": name} if name is not None else {}
     return nn.Embed(
         cfg.vocab_size,
         cfg.hidden_size,
@@ -315,7 +316,7 @@ def _make_embed(cfg: TransformerConfig, dtype) -> nn.Embed:
         embedding_init=nn.with_partitioning(
             nn.initializers.normal(0.02), ("vocab", "embed")
         ),
-        name="embed",
+        **kw,
     )
 
 
@@ -344,7 +345,7 @@ def _apply_layer_stack(cfg: TransformerConfig, x, *extra, decode=False,
     ``(x, None)``.
     """
     base_cls = block_cls or Block
-    block_kwargs = {"decode": decode} if block_cls is None else {}
+    block_kwargs = {"decode": decode}  # every block class supports decode
     cls = base_cls
     if cfg.remat:
         cls = nn.remat(

@@ -134,3 +134,41 @@ def test_decoder_forced_causal_even_with_noncausal_config():
     np.testing.assert_allclose(
         np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-5
     )
+
+
+def test_cached_generate_matches_full_recompute():
+    """KV-cached greedy decode must equal the argmax loop that re-runs the
+    whole decoder each step — the cache is layout, not math."""
+    cfg = _tiny_cfg()
+    model = Seq2SeqLM(cfg)
+    rng = np.random.default_rng(3)
+    src = jnp.asarray(rng.integers(1, 64, (2, 8)), jnp.int32)
+    params = model.init(
+        jax.random.PRNGKey(1), src, jnp.zeros((2, 1), jnp.int32)
+    )["params"]
+    out = model.generate(params, src, max_new_tokens=6, bos_token_id=0)
+
+    memory = model.apply({"params": params}, src, None,
+                         method=Seq2SeqLM.encode)
+    dec = jnp.zeros((2, 1), jnp.int32)
+    for _ in range(6):
+        logits = model.apply(
+            {"params": params}, dec, memory, None,
+            method=Seq2SeqLM.decode_logits,
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        dec = jnp.concatenate([dec, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(dec))
+
+
+def test_generate_bounds_and_zero_tokens():
+    cfg = _tiny_cfg(max_seq_len=8)
+    model = Seq2SeqLM(cfg)
+    src = jnp.ones((1, 4), jnp.int32)
+    params = model.init(
+        jax.random.PRNGKey(0), src, jnp.zeros((1, 1), jnp.int32)
+    )["params"]
+    with pytest.raises(ValueError, match="max_seq_len"):
+        model.generate(params, src, max_new_tokens=8)
+    out = model.generate(params, src, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), [[0]])
