@@ -825,6 +825,14 @@ class Accelerator:
     # ------------------------------------------------------------------ #
     # misc
     # ------------------------------------------------------------------ #
+    def get_state_dict(self, params: Any, unwrap: bool = True):
+        """Full de-sharded host state dict of a param tree (reference
+        accelerator.py:3230: gathers ZeRO-3/FSDP shards first; here the
+        all-gather happens per leaf via the checkpoint host-fetch)."""
+        from .checkpointing import _to_host, flatten_tree
+
+        return flatten_tree(_to_host(params))
+
     def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
         """No wrappers exist on TPU — identity (reference :3200)."""
         return model

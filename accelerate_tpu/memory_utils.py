@@ -1,0 +1,12 @@
+"""Deprecated shim (reference ``memory_utils.py:18-22`` keeps the same
+warning-only re-export for callers importing the pre-0.12 path)."""
+
+import warnings
+
+from .utils.memory import find_executable_batch_size  # noqa: F401
+
+warnings.warn(
+    "memory_utils is deprecated; import from accelerate_tpu.utils.memory "
+    "instead",
+    FutureWarning,
+)

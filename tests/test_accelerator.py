@@ -247,3 +247,31 @@ def test_trigger_roundtrip():
     accelerator.set_trigger()
     assert accelerator.check_trigger()
     assert not accelerator.check_trigger()
+
+
+def test_get_state_dict_full_host_copy():
+    """Reference accelerator.get_state_dict: full de-sharded named dict."""
+    acc = Accelerator(
+        parallelism_plugin=ParallelismPlugin(
+            dp_size=1, fsdp_size=8, min_weight_size=1
+        )
+    )
+    params = acc.prepare({"layer": {"kernel": jnp.arange(64.0).reshape(8, 8)}})
+    sd = acc.get_state_dict(params)
+    assert set(sd) == {"layer//kernel"}
+    np.testing.assert_allclose(
+        np.asarray(sd["layer//kernel"]), np.arange(64.0).reshape(8, 8)
+    )
+
+
+def test_memory_utils_shim_warns():
+    import importlib
+    import warnings
+
+    import accelerate_tpu.memory_utils as mu
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        importlib.reload(mu)
+    assert any(issubclass(x.category, FutureWarning) for x in w)
+    assert hasattr(mu, "find_executable_batch_size")
