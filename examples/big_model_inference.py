@@ -23,6 +23,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Allow running by path without a pip install: put the repo root on sys.path
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
 from accelerate_tpu import (
     Accelerator,
     ParallelismPlugin,

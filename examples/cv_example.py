@@ -17,6 +17,14 @@ import numpy as np
 import optax
 from torch.utils.data import DataLoader
 
+# Allow running by path without a pip install: put the repo root on sys.path
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
 from accelerate_tpu import Accelerator
 from accelerate_tpu.utils.random import set_seed
 

@@ -1,0 +1,128 @@
+"""Sequence-to-sequence training example: T5-family encoder-decoder.
+
+Counterpart of the reference's translation/summarization fine-tunes (the
+t5 member of its bert/gpt/t5 family — reference utils/megatron_lm.py
+t5 parser): same two-line-swap flow as nlp_example.py, on a hub-free
+synthetic task (sequence copying). Generation starts from BOS alone, so
+reproducing a source sequence exercises the full encode-once /
+KV-cached-decode path; at these toy sizes the model fits the training
+distribution rather than learning an abstract copy circuit, so the eval
+reports generation accuracy on training-distribution samples.
+
+Run (TPU if present):     python examples/seq2seq_example.py
+CPU mesh: see examples/README.md.
+"""
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+# Allow running by path without a pip install: put the repo root on sys.path
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import Seq2SeqLM, TransformerConfig
+from accelerate_tpu.utils.random import set_seed
+
+BOS, PAD = 0, 1
+
+
+def make_dataset(n: int, seq_len: int, vocab: int, seed: int):
+    """source = random tokens; target = source (copy task)."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(2, vocab, (n, seq_len)).astype(np.int32)
+    return src, src.copy()
+
+
+def compute_dtype(accelerator: Accelerator) -> str:
+    """Activation dtype for the model from the accelerator's policy."""
+    return jnp.dtype(
+        accelerator.state.mixed_precision_policy.compute_dtype
+    ).name
+
+
+def training_function(config, args):
+    set_seed(config["seed"])
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=config.get("grad_accum", 1),
+        cpu=args.cpu,
+    )
+
+    tiny = os.environ.get("TESTING_TINY_MODEL") == "1"
+    model_cfg = TransformerConfig(
+        vocab_size=128 if tiny else 512,
+        hidden_size=64 if tiny else 256,
+        intermediate_size=128 if tiny else 512,
+        num_layers=2, num_decoder_layers=2,
+        num_heads=4, max_seq_len=64, tie_embeddings=True,
+        dtype=compute_dtype(accelerator),
+    )
+    model = Seq2SeqLM(model_cfg)
+    seq_len = 12
+    src, tgt = make_dataset(
+        256 if tiny else 2048, seq_len, model_cfg.vocab_size, config["seed"]
+    )
+
+    dec_in = np.concatenate(
+        [np.full((len(tgt), 1), BOS, np.int32), tgt[:, :-1]], axis=1
+    )
+    params = accelerator.prepare(
+        model.init(
+            jax.random.PRNGKey(config["seed"]),
+            jnp.asarray(src[:1]), jnp.asarray(dec_in[:1]),
+        )["params"]
+    )
+    opt = accelerator.prepare(optax.adamw(config["lr"]))
+    carry = accelerator.init_carry(params, opt)
+    step = accelerator.unified_step(Seq2SeqLM.loss_fn(model))
+
+    bs = config["batch_size"]
+    n_batches = len(src) // bs
+    for epoch in range(config["num_epochs"]):
+        for i in range(n_batches):
+            sl = slice(i * bs, (i + 1) * bs)
+            batch = {
+                "input_ids": jnp.asarray(src[sl]),
+                "decoder_input_ids": jnp.asarray(dec_in[sl]),
+                "labels": jnp.asarray(tgt[sl]),
+            }
+            carry, metrics = step(carry, batch)
+        accelerator.print(
+            f"epoch {epoch}: loss {float(metrics['loss']):.4f}"
+        )
+
+    # eval: KV-cached greedy generation (starts from BOS — every emitted
+    # token must come through cross-attention) reproduces trained sources
+    ev_src, ev_tgt = src[:32], tgt[:32]
+    out = model.generate(
+        carry["params"], jnp.asarray(ev_src),
+        max_new_tokens=seq_len, bos_token_id=BOS,
+    )
+    acc = float(np.mean(np.asarray(out[:, 1:]) == ev_tgt))
+    accelerator.print(f"generation exact-token accuracy: {acc:.3f}")
+    return {"accuracy": acc, "loss": float(metrics["loss"])}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="bf16",
+                        choices=["no", "bf16", "fp16", "fp8"])
+    parser.add_argument("--cpu", action="store_true",
+                        help="Force the CPU backend")
+    args = parser.parse_args()
+    config = {"lr": 3e-3, "num_epochs": 6, "seed": 42, "batch_size": 32}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

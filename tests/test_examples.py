@@ -206,3 +206,14 @@ def test_big_model_inference_example():
         )
     finally:
         sys.argv = old_argv
+
+
+@pytest.mark.slow
+def test_seq2seq_example_quality():
+    """BOS-seeded cached generation must reproduce trained sources — every
+    token flows through cross-attention."""
+    metric = _run_example(
+        "seq2seq_example", ["--mixed_precision", "no"],
+        config={"num_epochs": 30, "lr": 5e-3, "batch_size": 32},
+    )
+    assert metric["accuracy"] > 0.9, metric
