@@ -126,6 +126,13 @@ class LocalSGD:
     def _average(self, tree: Any) -> Any:
         from .utils.operations import reduce
 
+        if jax.process_count() == 1:
+            # cross-PROCESS mean of one process is the identity; skipping it
+            # also avoids flooding XLA:CPU's collective rendezvous with
+            # hundreds of small per-leaf eager programs between queued train
+            # steps (observed deadlock-abort on the virtual test mesh). The
+            # in-process replica-dim pattern averages via average_replicas.
+            return tree
         if isinstance(tree, dict) and "params" in tree:
             out = dict(tree)
             out["params"] = reduce(tree["params"], "mean")

@@ -1,0 +1,121 @@
+"""Feature example: Local SGD (train replicas independently, average
+parameters every K steps).
+
+Reference ``examples/by_feature/local_sgd.py`` suppresses DDP's per-step
+gradient all-reduce and all-reduces *parameters* every ``local_sgd_steps``
+steps. Under SPMD there is no per-step hook to suppress — independence is
+expressed structurally, in one of two ways (both in
+``accelerate_tpu/local_sgd.py``):
+
+* **single host** (this script): give every data-parallel group its OWN
+  weights by stacking params on a dp-sharded replica dim
+  (``replicate_params``), train them with a vmapped loss (no cross-replica
+  grad sync happens because no axis ties them), and collapse with
+  ``average_replicas`` every K steps — XLA lowers the mean to one
+  all-reduce over the dp axis.
+* **multi process** (pods): keep each process's params host-local and wrap
+  the loop in ``LocalSGD``; see
+  ``accelerate_tpu/test_utils/scripts/multiprocess_worker.py::local_sgd_worker``
+  for the runnable world>1 version (exercised in CI by
+  ``tests/test_launchers.py``).
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+# Allow running by path without a pip install: put the repo root on sys.path
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+)
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.local_sgd import average_replicas, replicate_params
+from accelerate_tpu.utils.random import set_seed
+
+
+def training_function(config, args):
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+    set_seed(config["seed"])
+    mesh = accelerator.mesh
+    n_replicas = mesh.shape["dp"]
+    accelerator.print(f"{n_replicas} independent replicas over the dp axis")
+
+    # a linear-regression model per replica; every replica sees a DIFFERENT
+    # data shard (the whole point: no per-step sync, real divergence)
+    rng = np.random.default_rng(config["seed"])
+    true_w = np.asarray([2.0, -1.0, 0.5, 3.0], np.float32)
+    xs = rng.normal(size=(n_replicas, 512, 4)).astype(np.float32)
+    ys = xs @ true_w + 0.05 * rng.normal(size=(n_replicas, 512)).astype(np.float32)
+
+    params = {"w": jnp.zeros((4,)), "b": jnp.asarray(0.0)}
+    reps = replicate_params(params, mesh)  # leading dp-sharded replica dim
+
+    opt = optax.sgd(config["lr"])
+    opt_state = jax.vmap(opt.init)(reps)
+
+    def replica_loss(p, x, y):
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    @jax.jit
+    def local_step(reps, opt_state, x, y):
+        """Each replica updates on ITS OWN grads — vmap, no collectives."""
+        grads = jax.vmap(jax.grad(replica_loss))(reps, x, y)
+
+        def one(g, s, p):
+            u, s = opt.update(g, s, p)
+            return optax.apply_updates(p, u), s
+
+        return jax.vmap(one)(grads, opt_state, reps)
+
+    @jax.jit
+    def spread(reps):
+        """Max parameter distance between replicas — the divergence meter."""
+        return jnp.max(jnp.abs(reps["w"] - jnp.mean(reps["w"], 0)))
+
+    bs = config["batch_size"]
+    steps = config["num_steps"]
+    max_spread = 0.0
+    for step in range(1, steps + 1):
+        lo = ((step - 1) * bs) % 512
+        x = jnp.asarray(xs[:, lo:lo + bs])
+        y = jnp.asarray(ys[:, lo:lo + bs])
+        reps, opt_state = local_step(reps, opt_state, x, y)
+        if step % args.local_sgd_steps == 0:
+            before = float(spread(reps))
+            max_spread = max(max_spread, before)
+            # New code: the Local SGD sync — one parameter mean over the
+            # dp axis, every local_sgd_steps steps
+            avg = average_replicas(reps)
+            reps = replicate_params(avg, mesh)
+            accelerator.print(
+                f"step {step}: replica spread {before:.4f} -> "
+                f"{float(spread(reps)):.6f} after averaging"
+            )
+
+    final = average_replicas(reps)
+    err = float(jnp.max(jnp.abs(final["w"] - jnp.asarray(true_w))))
+    accelerator.print(f"|w - w*|_inf after local SGD: {err:.4f}")
+    return {"weight_error": err, "max_spread": max_spread}
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Local SGD example.")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--local_sgd_steps", type=int, default=8,
+                        help="Average replicas every this many steps.")
+    args = parser.parse_args()
+    config = {"lr": 0.05, "num_steps": 48, "seed": 42, "batch_size": 32}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -217,3 +217,31 @@ def test_seq2seq_example_quality():
         config={"num_epochs": 30, "lr": 5e-3, "batch_size": 32},
     )
     assert metric["accuracy"] > 0.9, metric
+
+
+@pytest.mark.slow
+def test_local_sgd_example():
+    """Replicas must genuinely diverge between syncs and still land on the
+    generating weights after averaging."""
+    metric = _run_example(
+        "local_sgd", ["--cpu", "--local_sgd_steps", "8"],
+        config={"lr": 0.05, "num_steps": 48, "seed": 42, "batch_size": 32},
+    )
+    assert metric["weight_error"] < 0.1, metric
+    # replicas really trained without sync between averages
+    assert metric["max_spread"] > 1e-3, metric
+
+
+@pytest.mark.slow
+def test_profiler_example(tmp_path):
+    metric = _run_example(
+        "profiler",
+        ["--cpu", "--profile_dir", str(tmp_path / "trace")],
+        env={"TESTING_NUM_EPOCHS": "1"},
+        config={"num_epochs": 1, "lr": 3e-4, "seed": 42, "batch_size": 16},
+    )
+    assert metric["accuracy"] > 0.55, metric
+    import glob as _glob
+
+    assert _glob.glob(str(tmp_path / "trace" / "**" / "*.xplane.pb"),
+                      recursive=True)
