@@ -44,15 +44,17 @@ MIN_BLOCK = 8  # f32 sublane granularity; small blocks run, just slowly
 
 
 def fit_block(seq: int, preferred: int):
-    """Largest block <= preferred that divides ``seq``, halving down to
-    MIN_BLOCK. A sequence that fits entirely (seq <= preferred) is always
-    its own block. None when nothing fits (odd seq > preferred)."""
+    """Largest block <= preferred that divides ``seq`` AND is a multiple of
+    the 8-row f32 sublane granularity, halving down from preferred. None
+    when no aligned divisor exists (callers fall back to dense): unaligned
+    blocks may run in CPU interpret mode but fail to compile or pad badly
+    on real TPU Pallas."""
     b = min(preferred, seq)
     while b >= MIN_BLOCK:
-        if seq % b == 0:
+        if seq % b == 0 and b % MIN_BLOCK == 0:
             return b
         b //= 2
-    return seq if seq <= preferred else None
+    return None
 NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN from inf-inf
 
 

@@ -153,8 +153,22 @@ def MegatronLMPlugin(
             "ParallelismPlugin.ep_size to shard experts"
         )
     _warn_ignored("MegatronLMPlugin", ignored)
-    return ParallelismPlugin(
+    plugin = ParallelismPlugin(
         tp_size=tp_degree,
         pp_size=pp_degree,
         num_micro_batches=max(num_micro_batches, pp_degree),
     )
+    # Surface unsupported degree combinations HERE, where the migration
+    # context is visible, rather than later inside build_mesh. Delegates to
+    # the live pipeline validator so the shim never drifts from what the
+    # mesh actually accepts.
+    from ..parallel.pipeline import validate_pipeline_plugin
+
+    try:
+        validate_pipeline_plugin(plugin)
+    except NotImplementedError as e:
+        raise NotImplementedError(
+            f"MegatronLMPlugin(tp_degree={tp_degree}, pp_degree={pp_degree}"
+            f"): {e}"
+        ) from None
+    return plugin

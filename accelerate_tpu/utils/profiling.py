@@ -77,6 +77,11 @@ class PeakHostMemory:
                 break
 
     def start(self):
+        if self._monitoring:
+            raise RuntimeError(
+                "PeakHostMemory.start() while already monitoring; use one "
+                "tracker per measurement bracket"
+            )
         self._monitoring = True
         self._thread = threading.Thread(target=self._monitor, daemon=True)
         self._thread.start()
@@ -98,7 +103,12 @@ def start_measure() -> dict[str, Any]:
         stats = device_memory_stats(d)
         measures[f"device:{i}"] = stats["bytes_in_use"]
         measures[f"device:{i}-peak"] = stats["peak_bytes_in_use"]
-    _peak_tracker.start()
+    # fresh tracker per bracket: a shared singleton races under nested or
+    # concurrent measurement windows (second start() orphans the first
+    # thread and loses its peak)
+    tracker = PeakHostMemory()
+    tracker.start()
+    measures["_tracker"] = tracker
     return measures
 
 
@@ -114,7 +124,7 @@ def end_measure(start: dict[str, Any]) -> dict[str, Any]:
     out: dict[str, Any] = {"time": time.perf_counter() - start["time"]}
     gc.collect()
     out["host"] = host_memory_rss() - start["host"]
-    out["host-peak"] = max(0, _peak_tracker.stop() - start["host"])
+    out["host-peak"] = max(0, start["_tracker"].stop() - start["host"])
     for i, d in enumerate(jax.local_devices()):
         stats = device_memory_stats(d)
         out[f"device:{i}"] = stats["bytes_in_use"] - start[f"device:{i}"]
@@ -131,9 +141,6 @@ def log_measures(measures: dict[str, Any], description: str = "run") -> None:
     for key, value in measures.items():
         if key.startswith(("device", "host")):
             print(f"- {key}: {value >> 20} MiB")
-
-
-_peak_tracker = PeakHostMemory()
 
 
 # ---------------------------------------------------------------------- #

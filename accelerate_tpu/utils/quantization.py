@@ -234,6 +234,9 @@ def _jitted_quantized_apply(apply_fn: Callable, dtype) -> Callable:
     return _run
 
 
+# bounded LRU cache: long-lived processes quantizing many models must not
+# retain every compiled program + module reference forever
+_JIT_CACHE_MAX = 16
 _jit_cache: dict[Any, Callable] = {}
 
 
@@ -258,7 +261,11 @@ def quantized_apply(apply_fn: Callable, qparams: Any, *args, dtype=None, **kw):
         key = None
     if key is None:
         return _jitted_quantized_apply(apply_fn, dtype)(qparams, *args)
-    if key not in _jit_cache:
+    if key in _jit_cache:
+        _jit_cache[key] = _jit_cache.pop(key)  # LRU: refresh recency on hit
+    else:
+        while len(_jit_cache) >= _JIT_CACHE_MAX:
+            _jit_cache.pop(next(iter(_jit_cache)))
         _jit_cache[key] = _jitted_quantized_apply(apply_fn, dtype)
     return _jit_cache[key](qparams, *args)
 

@@ -161,8 +161,12 @@ def test_fit_block_and_nonpow2_seq():
     assert fit_block(64, 1024) == 64  # short seqs are their own block
     assert fit_block(192, 128) == 64
     assert fit_block(128, 64) == 64  # explicit small block still honored
-    assert fit_block(100, 1024) == 100
+    # unaligned seqs (not a multiple of the 8-row sublane) must fall back
+    # to dense rather than hand Pallas a misaligned block
+    assert fit_block(100, 1024) is None
+    assert fit_block(20, 1024) is None
     assert fit_block(1001, 512) is None  # odd seq > preferred: no block
+    assert fit_block(24, 1024) == 24  # aligned short seq is its own block
 
     q, k, v = _qkv(S=384)  # 384 = 3*128: needs the adaptive step-down
     ref = xla_attention(q, k, v, causal=True)
