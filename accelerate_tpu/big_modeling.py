@@ -443,6 +443,8 @@ def load_checkpoint_and_dispatch(
     max_memory: Optional[dict] = None,
     offload_dir: Optional[str] = None,
     dtype: Any = None,
+    config: Any = None,
+    hf_format: Optional[bool] = None,
 ) -> Any:
     """Stream a (possibly sharded) safetensors checkpoint into placement.
 
@@ -455,8 +457,27 @@ def load_checkpoint_and_dispatch(
 
     ``abstract_params``: the ShapeDtypeStruct tree from
     :func:`init_empty_weights` (or a concrete tree of the right structure).
+
+    HF interop (reference big_modeling.py:499 consumes hub checkpoints
+    directly): when the checkpoint uses HF transformers key conventions —
+    auto-detected, or forced via ``hf_format=True`` — tensors are
+    assembled through :mod:`accelerate_tpu.utils.hf_interop` (per-layer
+    keys stacked into the nn.scan layout, torch->flax transposes, tied
+    embeddings). Requires ``config`` (a TransformerConfig; inferred from
+    a sibling ``config.json`` when omitted).
     """
-    named_on_disk = _lazy_checkpoint_reader(checkpoint)
+    if hf_format is None:
+        from .utils.hf_interop import is_hf_checkpoint
+
+        hf_format = is_hf_checkpoint(checkpoint)
+    if hf_format:
+        from .utils.hf_interop import hf_native_reader, infer_config_from_hf
+
+        if config is None:
+            config = infer_config_from_hf(checkpoint)
+        named_on_disk = hf_native_reader(checkpoint, config)
+    else:
+        named_on_disk = _lazy_checkpoint_reader(checkpoint)
 
     def materialize(name: str, template: Any):
         arr = named_on_disk(name)
@@ -467,6 +488,18 @@ def load_checkpoint_and_dispatch(
     flat, treedef = jax.tree_util.tree_flatten_with_path(abstract_params)
     from .checkpointing import _path_str
 
+    def check_consumed():
+        # a tensor the mapping never requested means the checkpoint holds
+        # parameters this architecture cannot represent (e.g. qkv biases
+        # of a lookalike arch) — loading would silently produce garbage
+        leftover = getattr(named_on_disk, "unconsumed", lambda: [])()
+        if leftover:
+            raise ValueError(
+                f"HF checkpoint tensors not consumed by the parameter "
+                f"mapping (first 8): {leftover[:8]} — the checkpoint's "
+                "architecture does not match the Llama/Mixtral layout"
+            )
+
     if mesh is not None:
         shardings = infer_param_shardings(
             abstract_params, mesh, plugin, logical_specs=logical_specs
@@ -476,11 +509,13 @@ def load_checkpoint_and_dispatch(
             jax.device_put(materialize(_path_str(path), t), s)
             for (path, t), s in zip(flat, flat_sh)
         ]
+        check_consumed()
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
     host_tree = jax.tree_util.tree_unflatten(
         treedef, [materialize(_path_str(p), t) for p, t in flat]
     )
+    check_consumed()
     if device_map == "auto" or device_map is None:
         device_map = infer_auto_device_map(host_tree, max_memory)
     return dispatch_params(host_tree, device_map, offload_dir=offload_dir)

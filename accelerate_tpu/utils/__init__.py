@@ -29,6 +29,13 @@ from .dataclasses import (
     ShardingStrategy,
     TensorInformation,
 )
+from .hf_interop import (
+    hf_native_reader,
+    infer_config_from_hf,
+    is_hf_checkpoint,
+    native_to_hf,
+    save_hf_checkpoint,
+)
 from .environment import (
     clear_environment,
     get_hbm_bytes_per_device,

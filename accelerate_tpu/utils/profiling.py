@@ -17,6 +17,7 @@ import gc
 import os
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -61,20 +62,30 @@ def host_memory_rss() -> int:
 
 
 class PeakHostMemory:
-    """Background sampler for peak host RSS (reference PeakCPUMemory:22 —
-    same busy-poll design: sleeping misses the peak)."""
+    """Background sampler for peak host RSS (reference PeakCPUMemory:22).
+
+    The monitor thread holds only a WEAK reference to the tracker: a
+    bracket abandoned without ``stop()`` (exception between start_measure
+    and end_measure) exits its thread as soon as the tracker is GC'd,
+    instead of busy-polling a core for the process lifetime. The 1 ms
+    sleep bounds the poll at ~1 kHz — still far denser than real RSS
+    transients — and gives the GC a chance to run.
+    """
 
     def __init__(self):
         self._monitoring = False
         self._peak = -1
         self._thread: Optional[threading.Thread] = None
 
-    def _monitor(self):
-        self._peak = -1
+    @staticmethod
+    def _monitor(ref: "weakref.ref[PeakHostMemory]"):
         while True:
-            self._peak = max(self._peak, host_memory_rss())
-            if not self._monitoring:
+            self = ref()
+            if self is None or not self._monitoring:
                 break
+            self._peak = max(self._peak, host_memory_rss())
+            del self  # don't pin the tracker between samples
+            time.sleep(0.001)
 
     def start(self):
         if self._monitoring:
@@ -83,7 +94,11 @@ class PeakHostMemory:
                 "tracker per measurement bracket"
             )
         self._monitoring = True
-        self._thread = threading.Thread(target=self._monitor, daemon=True)
+        self._peak = host_memory_rss()
+        self._thread = threading.Thread(
+            target=PeakHostMemory._monitor, args=(weakref.ref(self),),
+            daemon=True,
+        )
         self._thread.start()
 
     def stop(self) -> int:

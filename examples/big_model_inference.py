@@ -13,6 +13,12 @@ Two placement modes, both demonstrated:
 Hub-free: a synthetic checkpoint is written locally first. Run:
 
     python examples/big_model_inference.py [--max_memory_mb 1] [--seq 32]
+
+Real-checkpoint mode: pass ``--hf_checkpoint /path/to/hf_model`` (a
+directory holding HF-transformers-layout safetensors + config.json, e.g.
+a downloaded Llama or Mixtral snapshot) and both placement modes run on
+those weights instead — the per-layer HF keys are assembled into the
+stacked nn.scan layout on the fly (utils/hf_interop.py).
 """
 
 import argparse
@@ -53,21 +59,40 @@ def main():
         "--max_memory_mb", type=float, default=None,
         help="Artificially cap device memory to force cpu/disk spill",
     )
+    parser.add_argument(
+        "--hf_checkpoint", type=str, default=None,
+        help="Directory with an HF-layout (Llama/Mixtral) safetensors "
+        "checkpoint + config.json; replaces the synthetic checkpoint",
+    )
     args = parser.parse_args()
 
-    cfg = TransformerConfig.tiny(max_seq_len=128)
-    model = CausalLM(cfg)
-
     workdir = tempfile.mkdtemp(prefix="big_model_")
-    ckpt_dir = os.path.join(workdir, "ckpt")
     offload_dir = os.path.join(workdir, "offload")
 
-    # --- someone trained a model and saved sharded weights ---
-    params = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
-    )["params"]
-    save_model_weights(params, ckpt_dir, max_shard_size="2MB")
-    print(f"checkpoint written to {ckpt_dir}")
+    load_kwargs = {}
+    if args.hf_checkpoint is not None:
+        from accelerate_tpu.utils.hf_interop import infer_config_from_hf
+
+        ckpt_dir = args.hf_checkpoint
+        cfg = infer_config_from_hf(ckpt_dir)
+        model = CausalLM(cfg)
+        # pass the parsed config through so each load call doesn't
+        # re-detect the format and re-parse config.json
+        load_kwargs = {"config": cfg, "hf_format": True}
+        print(f"HF checkpoint: {ckpt_dir} "
+              f"({cfg.num_layers}L/{cfg.hidden_size}H, "
+              f"{'MoE' if cfg.num_experts else 'dense'})")
+    else:
+        cfg = TransformerConfig.tiny(max_seq_len=128)
+        model = CausalLM(cfg)
+        ckpt_dir = os.path.join(workdir, "ckpt")
+
+        # --- someone trained a model and saved sharded weights ---
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        save_model_weights(params, ckpt_dir, max_shard_size="2MB")
+        print(f"checkpoint written to {ckpt_dir}")
 
     # --- abstract init: the full tree as shapes, zero bytes allocated ---
     abstract = init_empty_weights(
@@ -83,7 +108,7 @@ def main():
     # mode 1: GSPMD — stream shards straight onto mesh shardings
     loaded = load_checkpoint_and_dispatch(
         abstract, ckpt_dir, mesh=acc.mesh,
-        plugin=acc.state.parallelism_plugin,
+        plugin=acc.state.parallelism_plugin, **load_kwargs,
     )
     out = generate(model, loaded, prompt, max_new_tokens=args.new_tokens)
     print("GSPMD generate:", np.asarray(out)[0, -args.new_tokens:].tolist())
@@ -97,6 +122,7 @@ def main():
     print(f"device_map tiers in use: {tiers}")
     placed = load_checkpoint_and_dispatch(
         abstract, ckpt_dir, device_map=device_map, offload_dir=offload_dir,
+        **load_kwargs,
     )
     live = materialize_offloaded(placed)
     out2 = generate(model, live, prompt, max_new_tokens=args.new_tokens)
