@@ -103,7 +103,7 @@ class Attention(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, mask=None):
+    def __call__(self, x, positions, mask=None, kv_lengths=None):
         decode = self.decode
         cfg = self.config
         dtype = _dtype(cfg)
@@ -168,6 +168,7 @@ class Attention(nn.Module):
             k = rope(k, positions, cfg.rope_theta)
             out = dot_product_attention(
                 q, k, v, mask=mask, causal=cfg.causal,
+                kv_lengths=kv_lengths,
                 implementation=cfg.attention_impl,
             )
         # named residual: the "save_attn" remat policy keeps exactly these,
@@ -290,12 +291,12 @@ class Block(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, mask=None):
+    def __call__(self, x, positions, mask=None, kv_lengths=None):
         from ..parallel.sharding import constrain_activations
 
         cfg = self.config
         h = x + Attention(cfg, decode=self.decode, name="attn")(
-            RMSNorm(cfg, name="attn_norm")(x), positions, mask
+            RMSNorm(cfg, name="attn_norm")(x), positions, mask, kv_lengths
         )
         ff = MoE(cfg, name="moe") if cfg.num_experts > 0 else MLP(cfg, name="mlp")
         # pin the residual stream's layout once per layer so GSPMD cannot
@@ -449,6 +450,15 @@ class SequenceClassifier(nn.Module):
 
     ``__call__(input_ids, attention_mask=None) -> (B, num_labels) logits``
     with ``attention_mask`` 1 = real token, 0 = padding.
+
+    Attention-mask routing: where the flash kernel actually runs
+    (``attention_impl="flash"``, or auto-dispatch selecting flash on TPU)
+    the mask is treated as RIGHT padding and lowered to per-row valid
+    lengths — the universal HF tokenizer convention (reference
+    examples/nlp_example.py:83-96 pads right) — letting padded batches run
+    the O(S)-memory flash kernel and skip fully-padded kv blocks. Every
+    other path applies the exact dense (B,1,1,S) key mask, correct for ANY
+    0/1 pattern; non-contiguous masks require ``attention_impl="xla"``.
     """
 
     config: TransformerConfig
@@ -460,12 +470,26 @@ class SequenceClassifier(nn.Module):
         dtype = _dtype(cfg)
         b, s = input_ids.shape
         positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-        attn_mask4d = None
+        # Mask routing (see class docstring): lower the mask to
+        # right-padding lengths ONLY where the flash kernel actually runs
+        # (explicit "flash", or auto-dispatch selecting it); every other
+        # path keeps the exact dense key mask, correct for ANY pattern.
+        from ..ops.attention import flash_self_attention_eligible
+
+        attn_mask4d = kv_lengths = None
         if attention_mask is not None:
-            # (B, S) keep-mask -> (B, 1, 1, S): padded keys invisible to all
-            attn_mask4d = attention_mask[:, None, None, :] > 0
+            use_flash = cfg.attention_impl == "flash" or (
+                cfg.attention_impl is None and flash_self_attention_eligible(s)
+            )
+            if use_flash:
+                kv_lengths = jnp.sum(
+                    attention_mask > 0, axis=-1
+                ).astype(jnp.int32)
+            else:
+                # (B, S) keep-mask -> (B, 1, 1, S): padded keys invisible
+                attn_mask4d = attention_mask[:, None, None, :] > 0
         x = _make_embed(cfg, dtype)(input_ids)
-        x = _apply_layer_stack(cfg, x, positions, attn_mask4d)
+        x = _apply_layer_stack(cfg, x, positions, attn_mask4d, kv_lengths)
         x = RMSNorm(cfg, name="final_norm")(x)
 
         if attention_mask is None:

@@ -42,6 +42,12 @@ def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     )
 
 
+def lengths_to_mask(kv_lengths: jax.Array, kv_len: int) -> jax.Array:
+    """(B,) valid-prefix lengths -> (B, 1, 1, kv_len) bool key mask."""
+    cols = jnp.arange(kv_len)[None, :]
+    return (cols < kv_lengths[:, None])[:, None, None, :]
+
+
 def xla_attention(
     q: jax.Array,
     k: jax.Array,
@@ -50,6 +56,7 @@ def xla_attention(
     bias: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     causal: bool = False,
+    kv_lengths: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference-path attention, shapes (B, S, H, D) / kv (B, Skv, Hkv, D).
 
@@ -68,11 +75,34 @@ def xla_attention(
     if causal:
         cmask = make_causal_mask(q.shape[1], k.shape[1])
         logits = jnp.where(cmask[None, None, :, :], logits, jnp.finfo(jnp.float32).min)
+    if kv_lengths is not None:
+        mask = (
+            lengths_to_mask(kv_lengths, k.shape[1])
+            if mask is None
+            else jnp.logical_and(mask, lengths_to_mask(kv_lengths, k.shape[1]))
+        )
     if mask is not None:
         # mask: broadcastable to (B, H, Q, K); True = attend
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(orig_dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_self_attention_eligible(seq_len: int) -> bool:
+    """Would auto-dispatch pick the flash kernel for self-attention at this
+    sequence length (no dense mask/bias)? Mirrors the flash_ok predicate in
+    :func:`dot_product_attention`; models use it to decide whether to
+    lower a right-padded attention mask to kv_lengths (flash fast path) or
+    keep the exact dense key mask (xla path)."""
+    from .flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, fit_block
+
+    return (
+        jax.default_backend() == "tpu"
+        and seq_len >= 256
+        and seq_len % 128 == 0
+        and fit_block(seq_len, DEFAULT_BLOCK_Q) is not None
+        and fit_block(seq_len, DEFAULT_BLOCK_K) is not None
+    )
 
 
 def dot_product_attention(
@@ -83,13 +113,19 @@ def dot_product_attention(
     bias: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     causal: bool = False,
+    kv_lengths: Optional[jax.Array] = None,
     implementation: Optional[str] = None,
 ) -> jax.Array:
     """Attention entry point, shapes (batch, seq, heads, head_dim).
 
+    ``kv_lengths``: (B,) valid-prefix key lengths — the structured form of
+    a right-padding key mask (HF tokenizer convention). Flash and xla both
+    honor it; arbitrary (non-prefix) masks take the xla path.
+
     ``implementation``: None (auto) | "xla" | "flash" | "ring".
-    Auto picks flash on TPU backends for causal self-attention with no
-    custom bias, else xla.
+    Auto picks flash on TPU backends for causal or bidirectional
+    self-attention with no custom mask/bias tensor (kv_lengths is fine —
+    that's the padded-batch fast path), else xla.
     """
     if implementation is None:
         # trace-time decision: tracers have no .devices(), so key off the
@@ -102,7 +138,7 @@ def dot_product_attention(
 
         on_tpu = jax.default_backend() == "tpu"
         flash_ok = (
-            on_tpu and causal and bias is None and mask is None
+            on_tpu and bias is None and mask is None
             and q.shape[1] == k.shape[1] and q.shape[1] >= 256
             # auto-dispatch stays conservative: lane-aligned seqs only
             and q.shape[1] % 128 == 0
@@ -111,21 +147,26 @@ def dot_product_attention(
         )
         implementation = "flash" if flash_ok else "xla"
     if implementation == "xla":
-        return xla_attention(q, k, v, mask=mask, bias=bias, scale=scale, causal=causal)
+        return xla_attention(
+            q, k, v, mask=mask, bias=bias, scale=scale, causal=causal,
+            kv_lengths=kv_lengths,
+        )
     if implementation == "flash":
         from .flash_attention import flash_attention
 
         if mask is not None or bias is not None:
             raise ValueError(
-                "flash attention supports no custom mask/bias yet — use "
-                "implementation='xla' (or pad+loss-mask instead of an "
-                "attention mask for causal LM training)"
+                "flash attention supports no dense mask/bias tensor — pass "
+                "right-padding via kv_lengths, or implementation='xla' for "
+                "arbitrary masks"
             )
-        return flash_attention(q, k, v, scale=scale, causal=causal)
+        return flash_attention(
+            q, k, v, scale=scale, causal=causal, kv_lengths=kv_lengths
+        )
     if implementation == "ring":
         from .ring_attention import ring_attention
 
-        if mask is not None or bias is not None:
+        if mask is not None or bias is not None or kv_lengths is not None:
             raise ValueError("ring attention supports no custom mask/bias")
         return ring_attention(q, k, v, scale=scale, causal=causal)
     raise ValueError(f"unknown attention implementation {implementation!r}")

@@ -68,11 +68,21 @@ def _causal_mask_block(iq, ik, bq, bk, offset):
     return cols <= rows + offset
 
 
-def _block_visible(iq, ik, bq, bk, causal: bool, offset: int = 0):
-    """Whether block pair (iq, ik) contains any unmasked entry."""
-    if not causal:
-        return jnp.asarray(True)
-    return ik * bk <= iq * bq + (bq - 1) + offset
+def _block_visible(iq, ik, bq, bk, causal: bool, offset: int = 0, kvlen=None):
+    """Whether block pair (iq, ik) contains any unmasked entry. ``kvlen``
+    (traced scalar, padding mode) additionally skips kv blocks that sit
+    entirely in the padded tail — heavily padded batches do
+    proportionally less work, the flash analog of ragged attention."""
+    vis = jnp.asarray(True) if not causal else ik * bk <= iq * bq + (bq - 1) + offset
+    if kvlen is not None:
+        vis = jnp.logical_and(vis, ik * bk < kvlen)
+    return vis
+
+
+def _apply_kv_padding(s, ik, bq, bk, kvlen):
+    """NEG_INF out score columns at-or-beyond the valid kv length."""
+    cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(cols < kvlen, s, NEG_INF)
 
 
 def _apply_causal(s, iq, ik, bq, bk, offset):
@@ -91,8 +101,14 @@ def _apply_causal(s, iq, ik, bq, bk, offset):
 # ---------------------------------------------------------------------- #
 # forward
 # ---------------------------------------------------------------------- #
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool, block_q: int, block_k: int, offset: int):
+def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
+                block_k: int, offset: int, padded: bool):
+    if padded:
+        lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        kvlen = lens_ref[pl.program_id(0)]
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        kvlen = None
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -103,7 +119,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # block is fully masked out when the q block sits above the diagonal
-    run = _block_visible(iq, ik, block_q, block_k, causal, offset)
+    # or entirely inside the padded kv tail
+    run = _block_visible(iq, ik, block_q, block_k, causal, offset, kvlen)
 
     @pl.when(run)
     def _body():
@@ -118,15 +135,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         ) * scale  # (bq, bk) f32
         if causal:
             s = _apply_causal(s, iq, ik, block_q, block_k, offset)
+        if padded:
+            s = _apply_kv_padding(s, ik, block_q, block_k, kvlen)
         m_prev = m_scr[:, 0:1]  # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        if causal and offset < 0:
-            # q_len > kv_len only: rows fully masked within a *visible*
-            # block (diagonal crossing mid-block) keep m_new == NEG_INF and
-            # exp(s - m_new) would be 1 everywhere — force p (and hence l,
-            # acc) to 0 so _finish emits zero output, not mean-of-v. With
-            # offset >= 0 every row sees >= 1 column, so the guard (a
-            # per-block vector op) is compiled out of the hot path.
+        if padded or (causal and offset < 0):
+            # Rows fully masked within a *visible* block keep m_new ==
+            # NEG_INF and exp(s - m_new) would be 1 everywhere — force p
+            # (and hence l, acc) to 0 so _finish emits zero output, not
+            # mean-of-v. Happens when the causal diagonal crosses
+            # mid-block with q_len > kv_len, or (padding mode) when
+            # kvlen == 0. Without either, every row sees >= 1 column and
+            # the guard is compiled out of the hot path.
             p = jnp.where(m_new <= NEG_INF * 0.5, 0.0, jnp.exp(s - m_new))
         else:
             p = jnp.exp(s - m_new)  # (bq, bk) f32
@@ -150,46 +170,73 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         )
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
+def _fwd(q, k, v, lengths, scale, causal, block_q, block_k):
     B, H, S, D = q.shape
     Hkv, Skv = k.shape[1], k.shape[2]
     g = H // Hkv
     bq, bk = min(block_q, S), min(block_k, Skv)
     nq, nk = pl.cdiv(S, bq), pl.cdiv(Skv, bk)
+    padded = lengths is not None
 
-    out, lse = pl.pallas_call(
-        functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            offset=Skv - S,
-        ),
-        grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, g=g: (b, h // g, ik, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, g=g: (b, h // g, ik, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, ik: (b, h, iq, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S, 128), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, D), jnp.float32),
-        ],
-    )(q, k, v)
+    # *refs absorbs the scalar-prefetch ref PrefetchScalarGridSpec appends
+    # to every index_map call in padding mode
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik, *refs: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, *refs, g=g: (b, h // g, ik, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, *refs, g=g: (b, h // g, ik, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik, *refs: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, ik, *refs: (b, h, iq, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        jax.ShapeDtypeStruct((B, H, S, 128), jnp.float32),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((bq, 128), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+        pltpu.VMEM((bq, D), jnp.float32),
+    ]
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        offset=Skv - S, padded=padded,
+    )
+    if padded:
+        out, lse = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(B, H, nq, nk),
+                in_specs=in_specs,
+                out_specs=out_specs,
+                scratch_shapes=scratch_shapes,
+            ),
+            out_shape=out_shape,
+        )(lengths, q, k, v)
+    else:
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(B, H, nq, nk),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=scratch_shapes,
+        )(q, k, v)
     return out, lse
 
 
 # ---------------------------------------------------------------------- #
 # backward
 # ---------------------------------------------------------------------- #
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_scr, *, scale, causal, block_q, block_k, offset):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, padded):
+    if padded:
+        (lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, acc_scr) = refs
+        kvlen = lens_ref[pl.program_id(0)]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr = refs
+        kvlen = None
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -197,7 +244,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = _block_visible(iq, ik, block_q, block_k, causal, offset)
+    run = _block_visible(iq, ik, block_q, block_k, causal, offset, kvlen)
 
     @pl.when(run)
     def _body():
@@ -212,11 +259,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         ) * scale
         if causal:
             s = _apply_causal(s, iq, ik, block_q, block_k, offset)
-        if causal and offset < 0:
-            # fully-masked query rows (q_len > kv_len) store lse=NEG_INF in
-            # forward; exp(NEG_INF - NEG_INF) = 1 would fabricate gradients
-            # for rows whose output is correctly zero — force p to 0 there
-            # (compiled out when offset >= 0: no row can be fully masked)
+        if padded:
+            s = _apply_kv_padding(s, ik, block_q, block_k, kvlen)
+        if padded or (causal and offset < 0):
+            # fully-masked query rows store lse=NEG_INF in forward;
+            # exp(NEG_INF - NEG_INF) = 1 would fabricate gradients for rows
+            # whose output is correctly zero — force p to 0 there
+            # (compiled out when unpadded with offset >= 0: no row can be
+            # fully masked)
             p = jnp.where(lse <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse))
         else:
             p = jnp.exp(s - lse)
@@ -233,10 +283,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, block_q, block_k, group, offset):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, group, offset,
+                    padded):
     # grid: (B, Hkv, n_kv, G, n_q) — dk/dv blocks live across (G, n_q)
+    if padded:
+        (lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        kvlen = lens_ref[pl.program_id(0)]
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        kvlen = None
     ik = pl.program_id(2)
     ig, iq = pl.program_id(3), pl.program_id(4)
     ng, nq = pl.num_programs(3), pl.num_programs(4)
@@ -246,7 +303,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = _block_visible(iq, ik, block_q, block_k, causal, offset)
+    run = _block_visible(iq, ik, block_q, block_k, causal, offset, kvlen)
 
     @pl.when(run)
     def _body():
@@ -261,7 +318,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ) * scale
         if causal:
             s = _apply_causal(s, iq, ik, block_q, block_k, offset)
-        if causal and offset < 0:
+        if padded:
+            s = _apply_kv_padding(s, ik, block_q, block_k, kvlen)
+        if padded or (causal and offset < 0):
             # see _bwd_dq_kernel: zero fully-masked rows (lse == NEG_INF)
             p = jnp.where(lse <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse))
         else:
@@ -285,76 +344,112 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(scale, causal, block_q, block_k, res, dout):
-    q, k, v, out, lse = res
+    q, k, v, lengths, out, lse = res
     B, H, S, D = q.shape
     Hkv, Skv = k.shape[1], k.shape[2]
     g = H // Hkv
     bq, bk = min(block_q, S), min(block_k, Skv)
     nq, nk = pl.cdiv(S, bq), pl.cdiv(Skv, bk)
+    padded = lengths is not None
 
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
 
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            offset=Skv - S,
-        ),
-        grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, g=g: (b, h // g, ik, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, g=g: (b, h // g, ik, 0)),
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, ik: (b, h, iq, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
-    )(q, k, v, dout, lse, delta)
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik, *refs: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, *refs, g=g: (b, h // g, ik, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik, *refs, g=g: (b, h // g, ik, 0)),
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik, *refs: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, ik, *refs: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, ik, *refs: (b, h, iq, 0)),
+    ]
+    dq_out_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik, *refs: (b, h, iq, 0))
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        offset=Skv - S, padded=padded,
+    )
+    dq_scratch = [pltpu.VMEM((bq, D), jnp.float32)]
+    if padded:
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(B, H, nq, nk),
+                in_specs=dq_in_specs,
+                out_specs=dq_out_spec,
+                scratch_shapes=dq_scratch,
+            ),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        )(lengths, q, k, v, dout, lse, delta)
+    else:
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(B, H, nq, nk),
+            in_specs=dq_in_specs,
+            out_specs=dq_out_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            scratch_shapes=dq_scratch,
+        )(q, k, v, dout, lse, delta)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            group=g, offset=Skv - S,
-        ),
-        grid=(B, Hkv, nk, g, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, hk, ik, ig, iq, g=g: (b, hk * g + ig, iq, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq: (b, hk, ik, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq: (b, hk, ik, 0)),
-            pl.BlockSpec((1, 1, bq, D), lambda b, hk, ik, ig, iq, g=g: (b, hk * g + ig, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 128), lambda b, hk, ik, ig, iq, g=g: (b, hk * g + ig, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 128), lambda b, hk, ik, ig, iq, g=g: (b, hk * g + ig, iq, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq: (b, hk, ik, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq: (b, hk, ik, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bk, D), jnp.float32),
-            pltpu.VMEM((bk, D), jnp.float32),
-        ],
-    )(q, k, v, dout, lse, delta)
-    return dq, dk, dv
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, hk, ik, ig, iq, *refs, g=g: (b, hk * g + ig, iq, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq, *refs: (b, hk, ik, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq, *refs: (b, hk, ik, 0)),
+        pl.BlockSpec((1, 1, bq, D), lambda b, hk, ik, ig, iq, *refs, g=g: (b, hk * g + ig, iq, 0)),
+        pl.BlockSpec((1, 1, bq, 128), lambda b, hk, ik, ig, iq, *refs, g=g: (b, hk * g + ig, iq, 0)),
+        pl.BlockSpec((1, 1, bq, 128), lambda b, hk, ik, ig, iq, *refs, g=g: (b, hk * g + ig, iq, 0)),
+    ]
+    dkv_out_specs = [
+        pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq, *refs: (b, hk, ik, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, hk, ik, ig, iq, *refs: (b, hk, ik, 0)),
+    ]
+    dkv_out_shape = [
+        jax.ShapeDtypeStruct(k.shape, k.dtype),
+        jax.ShapeDtypeStruct(v.shape, v.dtype),
+    ]
+    dkv_scratch = [
+        pltpu.VMEM((bk, D), jnp.float32),
+        pltpu.VMEM((bk, D), jnp.float32),
+    ]
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        group=g, offset=Skv - S, padded=padded,
+    )
+    if padded:
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(B, Hkv, nk, g, nq),
+                in_specs=dkv_in_specs,
+                out_specs=dkv_out_specs,
+                scratch_shapes=dkv_scratch,
+            ),
+            out_shape=dkv_out_shape,
+        )(lengths, q, k, v, dout, lse, delta)
+    else:
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid=(B, Hkv, nk, g, nq),
+            in_specs=dkv_in_specs,
+            out_specs=dkv_out_specs,
+            out_shape=dkv_out_shape,
+            scratch_shapes=dkv_scratch,
+        )(q, k, v, dout, lse, delta)
+    return dq, dk, dv, None
 
 
 # ---------------------------------------------------------------------- #
 # public wrapper with custom VJP
 # ---------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
-    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, lengths, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, lengths, scale, causal, block_q, block_k)
     return out
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, lengths, scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, lengths, scale, causal, block_q, block_k)
+    return out, (q, k, v, lengths, out, lse)
 
 def _flash_bwd(scale, causal, block_q, block_k, res, dout):
     return _bwd(scale, causal, block_q, block_k, res, dout)
@@ -368,14 +463,24 @@ def flash_attention(
     v: jax.Array,
     scale: Optional[float] = None,
     causal: bool = True,
+    kv_lengths: Optional[jax.Array] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
 ) -> jax.Array:
     """Flash attention, (batch, seq, heads, head_dim) layout, GQA-aware.
 
+    ``causal=False`` runs full bidirectional attention (the BERT-family
+    encoder path). ``kv_lengths`` (B,) int32 marks keys ``[0, len)`` valid
+    per batch row — the right-padding convention of every HF tokenizer
+    (reference examples/nlp_example.py:83-96 collate) — and masks the rest;
+    kv blocks entirely inside the padded tail are skipped, so heavily
+    padded batches do proportionally less work. Queries in the padded tail
+    still compute (their outputs are garbage); mask them downstream in
+    pooling/loss exactly as with a dense attention mask over keys.
+
     Blocks adapt downward to divide the sequence (1024 -> 512 -> 256 -> 128
-    steps), so any multiple of 128 works; callers with ragged lengths pad +
-    mask upstream.
+    steps), so any multiple of 128 works; non-contiguous key masks need the
+    xla path.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     # (B,S,H,D) -> (B,H,S,D)
@@ -387,5 +492,12 @@ def flash_attention(
             f"flash_attention needs seq divisible by a block size >= "
             f"{MIN_BLOCK}: q seq {qt.shape[2]}, kv seq {kt.shape[2]}"
         )
-    out = _flash(qt, kt, vt, scale, causal, bq, bk)
+    if kv_lengths is not None:
+        if kv_lengths.shape != (q.shape[0],):
+            raise ValueError(
+                f"kv_lengths must be shape ({q.shape[0]},), got "
+                f"{kv_lengths.shape}"
+            )
+        kv_lengths = kv_lengths.astype(jnp.int32)
+    out = _flash(qt, kt, vt, kv_lengths, scale, causal, bq, bk)
     return jnp.swapaxes(out, 1, 2)

@@ -130,6 +130,16 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
     with open(cfg_path) as f:
         hf = json.load(f)
     model_type = hf.get("model_type", "llama")
+    rope_scaling = hf.get("rope_scaling")
+    if rope_scaling and rope_scaling.get("rope_type", rope_scaling.get("type")) != "default":
+        # Llama-3.1+ scales rope frequencies (rope_type "llama3"); the
+        # native rope() uses plain theta — loading would pass every
+        # tensor check yet silently diverge from transformers logits.
+        raise ValueError(
+            f"HF config.json declares rope_scaling={rope_scaling!r}, which "
+            "the native rope implementation does not apply; only "
+            "plain-theta rope checkpoints (Llama-2/3.0 style) load"
+        )
     if model_type not in ("llama", "mixtral"):
         # Qwen2/Gemma/... share the model.layers.* key convention and every
         # config field this mapping reads, but differ in parameters the
@@ -363,6 +373,25 @@ def native_to_hf(params: Any, config) -> Iterator[tuple[str, np.ndarray]]:
                     )
 
 
+def _hf_emission_sizes(params: Any, config) -> list[int]:
+    """Per-emitted-HF-tensor byte sizes in :func:`native_to_hf` order,
+    computed from shapes only — no data is touched. Stacked leaves split
+    uniformly across their emitted per-layer(/expert) keys."""
+    from ..checkpointing import flatten_tree
+
+    sizes: list[int] = []
+    for name, leaf in sorted(flatten_tree(params).items()):
+        arr = leaf.value if hasattr(leaf, "value") else leaf
+        plan = _plan_for(_normalize(name), config)
+        n_keys = (
+            1 if plan.stack == 0
+            else sum(len(k) if isinstance(k, list) else 1 for k in plan.keys)
+        )
+        nbytes = int(np.prod(arr.shape)) * np.dtype(arr.dtype).itemsize
+        sizes.extend([nbytes // n_keys] * n_keys)
+    return sizes
+
+
 def save_hf_checkpoint(
     params: Any,
     config,
@@ -373,7 +402,13 @@ def save_hf_checkpoint(
     that ``transformers`` can load directly — the reverse interop of
     :func:`hf_native_reader` (reference save path accelerator.py:2712).
     Also writes a minimal ``config.json`` so :func:`infer_config_from_hf`
-    round-trips."""
+    round-trips.
+
+    Streaming: shard boundaries are planned from shapes alone, then each
+    shard is written (and freed) as soon as it fills — peak host memory is
+    the source params + ONE shard (max_shard_size), matching the
+    one-leaf-at-a-time property of the load path, not 2x the model.
+    """
     import jax
 
     from ..checkpointing import _save_named, parse_size
@@ -382,30 +417,38 @@ def save_hf_checkpoint(
     if jax.process_index() != 0:
         return
     limit = parse_size(max_shard_size)
+
+    # plan shard assignment without materializing any tensor
+    sizes = _hf_emission_sizes(params, config)
+    shard_of: list[int] = []
+    shard_idx, acc = 0, 0
+    for nbytes in sizes:
+        if shard_of and acc + nbytes > limit:
+            shard_idx, acc = shard_idx + 1, 0
+        shard_of.append(shard_idx)
+        acc += nbytes
+    n_shards = (shard_of[-1] + 1) if shard_of else 1
+
+    stem, ext = os.path.splitext(_HF_WEIGHTS_NAME)
+
+    def shard_name(i: int) -> str:
+        if n_shards == 1:
+            return _HF_WEIGHTS_NAME
+        return f"{stem}-{i + 1:05d}-of-{n_shards:05d}{ext}"
+
+    weight_map: dict[str, str] = {}
+    total = 0
     shard: dict[str, np.ndarray] = {}
-    shards: list[dict[str, np.ndarray]] = []
-    size = 0
-    for key, arr in native_to_hf(params, config):
-        nbytes = arr.nbytes
-        if shard and size + nbytes > limit:
-            shards.append(shard)
-            shard, size = {}, 0
+    current = 0
+    for i, (key, arr) in enumerate(native_to_hf(params, config)):
+        if shard_of[i] != current:
+            _save_named(shard, os.path.join(save_directory, shard_name(current)), True)
+            shard, current = {}, shard_of[i]
         shard[key] = arr
-        size += nbytes
-    if shard:
-        shards.append(shard)
-    if len(shards) == 1:
-        _save_named(shards[0], os.path.join(save_directory, _HF_WEIGHTS_NAME), True)
-    else:
-        weight_map: dict[str, str] = {}
-        total = 0
-        stem, ext = os.path.splitext(_HF_WEIGHTS_NAME)
-        for i, sh in enumerate(shards):
-            fname = f"{stem}-{i + 1:05d}-of-{len(shards):05d}{ext}"
-            _save_named(sh, os.path.join(save_directory, fname), True)
-            for k, a in sh.items():
-                weight_map[k] = fname
-                total += a.nbytes
+        weight_map[key] = shard_name(shard_of[i])
+        total += arr.nbytes
+    _save_named(shard, os.path.join(save_directory, shard_name(current)), True)
+    if n_shards > 1:
         with open(os.path.join(save_directory, _HF_INDEX_NAME), "w") as f:
             json.dump(
                 {"metadata": {"total_size": total}, "weight_map": weight_map},

@@ -242,6 +242,15 @@ def test_lookalike_arch_rejected(tmp_path):
     hf_cfg["model_type"] = "llama"
     json.dump(hf_cfg, open(cfg_path, "w"))
 
+    # 1b) rope_scaling (Llama-3.1 style) is not applied by native rope ->
+    # must be rejected, not silently produce diverging logits
+    hf_cfg["rope_scaling"] = {"rope_type": "llama3", "factor": 8.0}
+    json.dump(hf_cfg, open(cfg_path, "w"))
+    with pytest.raises(ValueError, match="rope_scaling"):
+        infer_config_from_hf(path)
+    del hf_cfg["rope_scaling"]
+    json.dump(hf_cfg, open(cfg_path, "w"))
+
     # 2) extra tensors the mapping never consumes -> load raises
     extra = os.path.join(path, "model.safetensors")
     from safetensors import safe_open

@@ -198,3 +198,36 @@ def test_grad_accum_equivalence_model():
     p_accum = run(2, [half1, half2])
     for a, b in zip(jax.tree.leaves(p_full), jax.tree.leaves(p_accum)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-6)
+
+
+def test_classifier_flash_padding_matches_xla():
+    """SequenceClassifier routes a right-padded attention_mask as
+    kv_lengths into the flash kernel; logits must equal the dense-mask
+    xla path (VERDICT r2: the BERT north-star config now touches the
+    flagship kernel)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    from accelerate_tpu.models import SequenceClassifier
+
+    rng = np.random.default_rng(0)
+    B, S = 4, 256
+    cfg_kw = dict(causal=False, max_seq_len=S, hidden_size=128, num_heads=4,
+                  vocab_size=512, intermediate_size=352, num_layers=2)
+    ids = jnp.asarray(rng.integers(0, 512, (B, S)), jnp.int32)
+    lens = np.array([S, 133, 7, 64])
+    mask = jnp.asarray((np.arange(S)[None, :] < lens[:, None]).astype(np.int32))
+
+    m_xla = SequenceClassifier(TransformerConfig(**cfg_kw, attention_impl="xla"))
+    m_flash = SequenceClassifier(
+        TransformerConfig(**cfg_kw, attention_impl="flash")
+    )
+    params = m_xla.init(jax.random.PRNGKey(0), ids, mask)["params"]
+    ref = m_xla.apply({"params": params}, ids, mask)
+    if jax.default_backend() == "tpu":
+        out = m_flash.apply({"params": params}, ids, mask)
+    else:
+        with pltpu.force_tpu_interpret_mode():
+            out = m_flash.apply({"params": params}, ids, mask)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4
+    )
