@@ -314,8 +314,13 @@ def _make_embed(cfg: TransformerConfig, dtype, name: Optional[str] = "embed") ->
         cfg.hidden_size,
         dtype=dtype,
         param_dtype=jnp.float32,
+        # vocab dim carries BOTH tp and the ZeRO seat (("vocab","zero") ->
+        # (tp, fsdp)); the feature dim stays replicated. Sharding the
+        # feature dim (what the fsdp heuristic would pick) makes every
+        # lookup hidden-sharded and triggers involuntary full reshards
+        # against the batch-sharded activation layout, fwd and bwd.
         embedding_init=nn.with_partitioning(
-            nn.initializers.normal(0.02), ("vocab", "embed")
+            nn.initializers.normal(0.02), (("vocab", "zero"), "embed")
         ),
         **kw,
     )
@@ -458,7 +463,9 @@ class SequenceClassifier(nn.Module):
     examples/nlp_example.py:83-96 pads right) — letting padded batches run
     the O(S)-memory flash kernel and skip fully-padded kv blocks. Every
     other path applies the exact dense (B,1,1,S) key mask, correct for ANY
-    0/1 pattern; non-contiguous masks require ``attention_impl="xla"``.
+    0/1 pattern. Non-prefix mask rows on the flash path are POISONED with
+    NaN (loud failure, never silently-wrong logits) — left-padded or
+    non-contiguous masks require ``attention_impl="xla"``.
     """
 
     config: TransformerConfig
@@ -476,20 +483,26 @@ class SequenceClassifier(nn.Module):
         # path keeps the exact dense key mask, correct for ANY pattern.
         from ..ops.attention import flash_self_attention_eligible
 
-        attn_mask4d = kv_lengths = None
+        attn_mask4d = kv_lengths = is_prefix = None
         if attention_mask is not None:
             use_flash = cfg.attention_impl == "flash" or (
                 cfg.attention_impl is None and flash_self_attention_eligible(s)
             )
             if use_flash:
-                kv_lengths = jnp.sum(
-                    attention_mask > 0, axis=-1
-                ).astype(jnp.int32)
+                keep = attention_mask > 0
+                kv_lengths = jnp.sum(keep, axis=-1).astype(jnp.int32)
+                # lengths are only faithful for right-padded (prefix-form)
+                # masks; a non-prefix row (e.g. padding_side="left") would
+                # silently attend to pads and drop real tokens — poison
+                # such rows with NaN so the loss screams instead
+                is_prefix = jnp.all(keep[:, 1:] <= keep[:, :-1], axis=-1)
             else:
                 # (B, S) keep-mask -> (B, 1, 1, S): padded keys invisible
                 attn_mask4d = attention_mask[:, None, None, :] > 0
         x = _make_embed(cfg, dtype)(input_ids)
         x = _apply_layer_stack(cfg, x, positions, attn_mask4d, kv_lengths)
+        if is_prefix is not None:
+            x = jnp.where(is_prefix[:, None, None], x, jnp.nan)
         x = RMSNorm(cfg, name="final_norm")(x)
 
         if attention_mask is None:

@@ -128,22 +128,14 @@ def dot_product_attention(
     that's the padded-batch fast path), else xla.
     """
     if implementation is None:
-        # trace-time decision: tracers have no .devices(), so key off the
-        # default backend (correct under jit on the target platform)
-        from .flash_attention import (
-            DEFAULT_BLOCK_K,
-            DEFAULT_BLOCK_Q,
-            fit_block,
-        )
-
-        on_tpu = jax.default_backend() == "tpu"
+        # trace-time decision: tracers have no .devices(), so the
+        # eligibility helper keys off the default backend (correct under
+        # jit on the target platform). ONE predicate — models route masks
+        # based on flash_self_attention_eligible, so dispatch must agree.
         flash_ok = (
-            on_tpu and bias is None and mask is None
-            and q.shape[1] == k.shape[1] and q.shape[1] >= 256
-            # auto-dispatch stays conservative: lane-aligned seqs only
-            and q.shape[1] % 128 == 0
-            and fit_block(q.shape[1], DEFAULT_BLOCK_Q) is not None
-            and fit_block(k.shape[1], DEFAULT_BLOCK_K) is not None
+            bias is None and mask is None
+            and q.shape[1] == k.shape[1]
+            and flash_self_attention_eligible(q.shape[1])
         )
         implementation = "flash" if flash_ok else "xla"
     if implementation == "xla":

@@ -202,27 +202,17 @@ def _fwd(q, k, v, lengths, scale, causal, block_q, block_k):
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         offset=Skv - S, padded=padded,
     )
-    if padded:
-        out, lse = pl.pallas_call(
-            kernel,
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=1,
-                grid=(B, H, nq, nk),
-                in_specs=in_specs,
-                out_specs=out_specs,
-                scratch_shapes=scratch_shapes,
-            ),
-            out_shape=out_shape,
-        )(lengths, q, k, v)
-    else:
-        out, lse = pl.pallas_call(
-            kernel,
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1 if padded else 0,
             grid=(B, H, nq, nk),
             in_specs=in_specs,
             out_specs=out_specs,
-            out_shape=out_shape,
             scratch_shapes=scratch_shapes,
-        )(q, k, v)
+        ),
+        out_shape=out_shape,
+    )(*(((lengths,) if padded else ()) + (q, k, v)))
     return out, lse
 
 
@@ -369,27 +359,18 @@ def _bwd(scale, causal, block_q, block_k, res, dout):
         offset=Skv - S, padded=padded,
     )
     dq_scratch = [pltpu.VMEM((bq, D), jnp.float32)]
-    if padded:
-        dq = pl.pallas_call(
-            dq_kernel,
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=1,
-                grid=(B, H, nq, nk),
-                in_specs=dq_in_specs,
-                out_specs=dq_out_spec,
-                scratch_shapes=dq_scratch,
-            ),
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        )(lengths, q, k, v, dout, lse, delta)
-    else:
-        dq = pl.pallas_call(
-            dq_kernel,
+    prefix = (lengths,) if padded else ()
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1 if padded else 0,
             grid=(B, H, nq, nk),
             in_specs=dq_in_specs,
             out_specs=dq_out_spec,
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             scratch_shapes=dq_scratch,
-        )(q, k, v, dout, lse, delta)
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(*(prefix + (q, k, v, dout, lse, delta)))
 
     dkv_in_specs = [
         pl.BlockSpec((1, 1, bq, D), lambda b, hk, ik, ig, iq, *refs, g=g: (b, hk * g + ig, iq, 0)),
@@ -415,27 +396,17 @@ def _bwd(scale, causal, block_q, block_k, res, dout):
         _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         group=g, offset=Skv - S, padded=padded,
     )
-    if padded:
-        dk, dv = pl.pallas_call(
-            dkv_kernel,
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=1,
-                grid=(B, Hkv, nk, g, nq),
-                in_specs=dkv_in_specs,
-                out_specs=dkv_out_specs,
-                scratch_shapes=dkv_scratch,
-            ),
-            out_shape=dkv_out_shape,
-        )(lengths, q, k, v, dout, lse, delta)
-    else:
-        dk, dv = pl.pallas_call(
-            dkv_kernel,
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1 if padded else 0,
             grid=(B, Hkv, nk, g, nq),
             in_specs=dkv_in_specs,
             out_specs=dkv_out_specs,
-            out_shape=dkv_out_shape,
             scratch_shapes=dkv_scratch,
-        )(q, k, v, dout, lse, delta)
+        ),
+        out_shape=dkv_out_shape,
+    )(*(prefix + (q, k, v, dout, lse, delta)))
     return dq, dk, dv, None
 
 

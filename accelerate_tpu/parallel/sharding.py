@@ -44,6 +44,14 @@ from .mesh import data_axes
 DEFAULT_LOGICAL_RULES: tuple[tuple[str, Optional[str]], ...] = (
     ("batch", MESH_AXIS_DATA),
     ("vocab", MESH_AXIS_TENSOR),
+    # "zero": explicit ZeRO-3 weight-shard seat, stacked onto the same dim
+    # as another logical axis (e.g. the embedding's vocab dim carries
+    # ("vocab", "zero") -> (tp, fsdp)). Used where the heuristic fsdp
+    # merge must NOT pick a free dim: sharding the embedding's feature dim
+    # makes every lookup output hidden-sharded and forces an involuntary
+    # full reshard to the batch-sharded activation layout (and the mirror
+    # reshard on the grad scatter) at dp x tp meshes.
+    ("zero", MESH_AXIS_FSDP),
     ("embed", None),
     ("heads", MESH_AXIS_TENSOR),
     ("kv", None),
@@ -159,6 +167,15 @@ def _merge_fsdp_into_spec(
     entries = list(spec) + [None] * (len(shape) - len(spec))
     if not shape or int(np.prod(shape)) < min_weight_size:
         return spec
+    # a "zero"-annotated leaf already carries its fsdp placement — adding
+    # a second fsdp dim would produce an invalid spec
+    flat = [
+        a
+        for e in entries
+        for a in (e if isinstance(e, (list, tuple)) else (e,))
+    ]
+    if MESH_AXIS_FSDP in flat:
+        return P(*entries)
     order = sorted(range(len(shape)), key=lambda i: (shape[i], i), reverse=True)
     for dim in order:
         if entries[dim] is None and shape[dim] % fsdp_size == 0 and shape[dim] >= fsdp_size:
@@ -193,6 +210,14 @@ def infer_param_shardings(
     )
     fsdp_size = mesh.shape[MESH_AXIS_FSDP]
 
+    def _usable(axis: Optional[str]) -> bool:
+        # the fsdp axis ("zero" seat) is a WEIGHT-shard placement: it only
+        # applies under ZeRO-3-style strategies — under ZeRO-1/2 params
+        # stay replicated and only opt state / grads shard over fsdp
+        if axis == MESH_AXIS_FSDP and not fsdp_on:
+            return False
+        return bool(axis) and mesh.shape[axis] > 1
+
     def _map_logical(leaf_spec: P, arr: Any) -> P:
         entries = []
         for name in leaf_spec:
@@ -200,11 +225,11 @@ def infer_param_shardings(
                 entries.append(None)
             elif isinstance(name, (list, tuple)):
                 axes = [rule_map.get(n) for n in name]
-                axes = [a for a in axes if a and mesh.shape[a] > 1]
+                axes = [a for a in axes if _usable(a)]
                 entries.append(tuple(axes) if axes else None)
             else:
                 axis = rule_map.get(name)
-                entries.append(axis if axis and mesh.shape[axis] > 1 else None)
+                entries.append(axis if _usable(axis) else None)
         spec = P(*entries)
         if fsdp_on:
             spec = _merge_fsdp_into_spec(spec, arr, fsdp_size, plugin.min_weight_size)

@@ -136,3 +136,33 @@ def test_zero1_step_built_before_init_carry():
     assert moment_specs
     for spec in moment_specs:
         assert any(s == "fsdp" for s in spec), spec
+
+
+def test_zero1_keeps_embedding_replicated():
+    """The embedding's ("vocab","zero") annotation is a WEIGHT-shard seat:
+    under ZeRO-1 (SHARD_OPT) params stay replicated — the fsdp axis must
+    not leak into param shardings through the zero rule (code-review r3)."""
+    from accelerate_tpu.models import CausalLM, TransformerConfig
+    from accelerate_tpu.parallel.mesh import build_mesh
+    from accelerate_tpu.parallel.sharding import (
+        get_logical_specs,
+        infer_param_shardings,
+        unbox_params,
+    )
+    from accelerate_tpu.utils.dataclasses import ParallelismPlugin, ShardingStrategy
+
+    plugin = ParallelismPlugin(
+        fsdp_size=8, sharding_strategy=ShardingStrategy.SHARD_OPT,
+        min_weight_size=16,
+    )
+    mesh = build_mesh(plugin)
+    cfg = TransformerConfig.tiny()
+    variables = CausalLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    specs = infer_param_shardings(
+        unbox_params(variables)["params"], mesh, plugin,
+        logical_specs=get_logical_specs(variables)["params"],
+    )
+    embed_spec = specs["embed"]["embedding"].spec
+    assert "fsdp" not in str(embed_spec), embed_spec
