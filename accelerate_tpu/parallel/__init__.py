@@ -7,6 +7,7 @@ from .mesh import (
 )
 from .pipeline import (
     pipeline_apply,
+    pipeline_train_step,
     stacked_layer_shardings,
     validate_pipeline_plugin,
 )

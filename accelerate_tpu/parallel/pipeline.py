@@ -13,10 +13,27 @@ activations to the next stage. Backward falls out of jax.grad through the
 scan (reverse pipeline schedule), so the same ``unified_step`` trains a
 pipelined model with zero engine code.
 
-Composition rules (v1): pp composes with dp/fsdp batch sharding (the batch
-dim stays sharded inside the stage compute). tp/sp/ep *inside* a pipelined
-stage would need nested collectives under shard_map and are rejected
-loudly in :func:`validate_pipeline_plugin`.
+Composition rules (v2): pp composes with dp/fsdp batch sharding AND with
+tp — the stage shard_map is PARTIAL-MANUAL (``axis_names={"pp"}``): only
+the pp axis is manual; every other mesh axis stays automatic, so GSPMD
+partitions the stage body over tp/dp/fsdp and inserts their collectives
+inside each pipeline stage (the Megatron pp x tp layout, reference
+utils/dataclasses.py:1338, reached here with zero engine code). sp/ep
+inside a stage remain rejected in :func:`validate_pipeline_plugin`.
+
+Two schedules:
+
+* :func:`pipeline_apply` — GPipe forward; backward falls out of jax.grad
+  (reverse schedule). Simple, composable with any downstream computation,
+  but autodiff saves residuals for ALL M microbatches per stage and the
+  output carry holds the full (M, ...) buffer.
+* :func:`pipeline_train_step` — true 1F1B: forward and backward microbatch
+  work interleave in ONE scan, per-stage in-flight inputs are bounded by a
+  ring buffer of depth 2S-1 (independent of M), backward recomputes the
+  stage from its saved input (activation-checkpoint style), and no output
+  buffer exists at all — the loss is computed per-microbatch on the last
+  stage. Peak activation HBM ~ (2S-1)/M of the GPipe path for M >> S.
+  Requires the loss to decompose per-microbatch (any mean/sum loss does).
 """
 
 from __future__ import annotations
@@ -48,6 +65,22 @@ def shard_map(f=None, **kwargs):
         kwargs[_REP_KWARG] = kwargs.pop("check_rep")
     return _shard_map(f, **kwargs) if f is not None else _shard_map(**kwargs)
 
+
+_PARTIAL_MANUAL = "axis_names" in _inspect.signature(_shard_map).parameters
+
+
+def _stage_shard_map(mesh, in_specs, out_specs):
+    """shard_map over ONLY the pp axis (partial-manual): tp/dp/fsdp stay
+    automatic so GSPMD partitions the stage body and inserts their
+    collectives inside each stage — this is what makes pp x tp compose.
+    Falls back to full-manual on older jax (pp-only meshes keep working;
+    validate_pipeline_plugin gates the rest)."""
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+    if _PARTIAL_MANUAL:
+        kwargs["axis_names"] = {MESH_AXIS_PIPELINE}
+    return functools.partial(shard_map, **kwargs)
+
 from ..utils.constants import MESH_AXIS_PIPELINE
 from ..utils.dataclasses import ParallelismPlugin
 from .mesh import data_axes
@@ -64,20 +97,34 @@ def validate_pipeline_plugin(
     slips past every check.
     """
     sizes = (
-        {"pp": resolved_shape["pp"], "tp_size": resolved_shape["tp"],
+        {"pp": resolved_shape["pp"],
          "sp_size": resolved_shape["sp"], "ep_size": resolved_shape["ep"]}
         if resolved_shape is not None
-        else {"pp": plugin.pp_size, "tp_size": plugin.tp_size,
+        else {"pp": plugin.pp_size,
               "sp_size": plugin.sp_size, "ep_size": plugin.ep_size}
     )
     pp = sizes.pop("pp")
     if pp in (1, -1):
         return
+    # tp composes since v2 via PARTIAL-MANUAL shard_map (tp stays an auto
+    # axis inside the stage body) — only available when jax's shard_map
+    # supports axis_names; on older jax full-manual would silently
+    # replicate tp (duplicate compute + per-step weight all-gather), so
+    # reject it there. sp/ep would need the ring / all-to-all collectives
+    # nested under the pp schedule — still rejected everywhere.
+    tp = (
+        resolved_shape["tp"] if resolved_shape is not None else plugin.tp_size
+    )
+    if tp not in (1, -1) and not _PARTIAL_MANUAL:
+        raise NotImplementedError(
+            f"pp_size={pp} with tp_size={tp} needs jax shard_map partial-"
+            "manual mode (axis_names), unavailable in this jax version"
+        )
     offending = {k: v for k, v in sizes.items() if v not in (1,)}
     if offending:
         raise NotImplementedError(
             f"pipeline parallelism (pp_size={pp}) cannot yet be "
-            f"combined with {offending}; use pp with dp/fsdp only"
+            f"combined with {offending}; use pp with dp/fsdp/tp only"
         )
     if plugin.num_micro_batches < pp:
         raise ValueError(
@@ -132,28 +179,20 @@ def pipeline_apply(
     if S == 1:
         return block_fn(stacked_params, x)
     B = x.shape[batch_dim]
-    if B % M:
-        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    xm = _microbatch(x, M, batch_dim)  # (B, ...) -> (M, B/M, ...)
 
-    # (B, ...) -> (M, B/M, ...) microbatch-major
-    xm = jnp.moveaxis(x, batch_dim, 0).reshape(
-        (M, B // M) + x.shape[:batch_dim] + x.shape[batch_dim + 1:]
-    )
-
-    batch_axes = data_axes(mesh)
-    # microbatch dim replicated; per-microbatch batch dim keeps data sharding
-    x_spec = P(None, batch_axes if mesh.shape[batch_axes[0]] > 1 else None)
+    if _PARTIAL_MANUAL:
+        # partial-manual: specs constrain only the pp axis; dp/fsdp/tp
+        # sharding of x and params is propagated by GSPMD (auto axes)
+        x_spec = P()
+    else:
+        batch_axes = data_axes(mesh)
+        x_spec = P(None, batch_axes if mesh.shape[batch_axes[0]] > 1 else None)
     param_specs = jax.tree.map(
         lambda l: P(MESH_AXIS_PIPELINE), stacked_params
     )
 
-    @functools.partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(param_specs, x_spec),
-        out_specs=x_spec,
-        check_rep=False,
-    )
+    @_stage_shard_map(mesh, (param_specs, x_spec), x_spec)
     def _pipelined(local_params, local_xm):
         stage = jax.lax.axis_index(MESH_AXIS_PIPELINE)
         perm = [(i, (i + 1) % S) for i in range(S)]
@@ -191,3 +230,154 @@ def pipeline_apply(
     ym = _pipelined(stacked_params, xm)
     y = ym.reshape((B,) + ym.shape[2:])
     return jnp.moveaxis(y, 0, batch_dim) if batch_dim != 0 else y
+
+
+def _microbatch(tree: Any, M: int, batch_dim: int = 0) -> Any:
+    """(B, ...) leaves -> (M, B/M, ...), microbatch-major."""
+
+    def _one(x):
+        B = x.shape[batch_dim]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible into {M} microbatches")
+        xm = jnp.moveaxis(x, batch_dim, 0)
+        return xm.reshape((M, B // M) + xm.shape[1:])
+
+    return jax.tree.map(_one, tree)
+
+
+def pipeline_train_step(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, Any], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    targets: Any,
+    *,
+    mesh: Mesh,
+    num_micro_batches: int,
+    batch_dim: int = 0,
+) -> tuple[jax.Array, Any]:
+    """One 1F1B pipeline training step: ``(loss, grads)`` in a single pass.
+
+    The schedule (synchronous 1F1B, Narayanan et al. PipeDream-Flush /
+    Megatron's default, reference utils/megatron_lm.py:1037-1058): each
+    scan tick carries a forward sub-phase and a backward sub-phase —
+    forward of microbatch ``j`` runs on stage ``i`` at tick ``i + j``; its
+    backward runs at tick ``2S - 2 - i + j`` (the last stage turns a
+    microbatch around in the same tick, feeding the loss cotangent
+    straight back). Activations ``ppermute`` forward, cotangents
+    ``ppermute`` backward, every tick.
+
+    Memory: each stage's RECOMPUTE state is an input ring buffer of depth
+    ``2S - 1`` — independent of ``M`` — and the block re-runs under
+    ``jax.vjp`` in the backward sub-phase (activation recompute). No
+    (M, ...) output buffer exists: ``loss_fn(y_mb, target_mb)`` is
+    evaluated per microbatch on the last stage and only the scalar sum
+    crosses stages (one psum), vs the GPipe path's full output
+    psum-broadcast. Caveat: the raw ``x``/``targets`` (M, ...) buffers are
+    still replicated onto every stage (O(M) per stage) — the (2S-1)/M
+    bound applies to the residual/output state, which dominates when the
+    per-stage block is deep; feed token ids (small) rather than
+    activations where possible.
+
+    ``loss_fn`` must decompose over microbatches: total loss is
+    ``mean_j loss_fn(y_j, t_j)`` (any per-sample mean/sum loss qualifies).
+    ``grads`` matches ``stacked_params``' structure (layer dim sharded
+    over pp). tp/dp/fsdp compose: the stage body runs under auto axes.
+    """
+    S = mesh.shape[MESH_AXIS_PIPELINE]
+    M = num_micro_batches
+    if S == 1:
+        def total(p):
+            xm = _microbatch(x, M, batch_dim)
+            tm = _microbatch(targets, M, batch_dim)
+            losses = jax.vmap(
+                lambda xx, tt: loss_fn(block_fn(p, xx), tt)
+            )(xm, tm)
+            return jnp.mean(losses)
+
+        return jax.value_and_grad(total)(stacked_params)
+
+    if not _PARTIAL_MANUAL:
+        # full-manual would batch-shard the data over dp but never reduce
+        # loss/dparams across the data axes — silently wrong grads. The
+        # 1F1B step is partial-manual-only by design.
+        raise NotImplementedError(
+            "pipeline_train_step needs jax shard_map partial-manual mode "
+            "(axis_names), unavailable in this jax version — use "
+            "pipeline_apply (GPipe) + jax.grad instead"
+        )
+    xm = _microbatch(x, M, batch_dim)
+    tm = _microbatch(targets, M, batch_dim)
+    param_specs = jax.tree.map(lambda l: P(MESH_AXIS_PIPELINE), stacked_params)
+    data_spec = P()
+    t_specs = jax.tree.map(lambda _: data_spec, tm)
+    R = 2 * S - 1  # ring depth: max input lifetime is 2(S-1) ticks (stage 0)
+    T = M + 2 * S - 2
+
+    @_stage_shard_map(
+        mesh, (param_specs, data_spec, t_specs), (P(), param_specs)
+    )
+    def _run(local_params, local_xm, local_tm):
+        stage = jax.lax.axis_index(MESH_AXIS_PIPELINE)
+        is_last = stage == S - 1
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]  # i -> i+1, 0 gets zeros
+        bwd_perm = [(i + 1, i) for i in range(S - 1)]  # i -> i-1, S-1 gets zeros
+
+        def tick(carry, t):
+            fwd_msg, bwd_msg, ring, dparams, loss_acc = carry
+            # ---- forward sub-phase: microbatch jf = t - stage ---------- #
+            jf = t - stage
+            active_f = jnp.logical_and(jf >= 0, jf < M)
+            jf_c = jnp.clip(jf, 0, M - 1)
+            feed = jax.lax.dynamic_index_in_dim(local_xm, jf_c, 0, keepdims=False)
+            x_in = jnp.where(stage == 0, feed, fwd_msg)
+            y = block_fn(local_params, x_in)
+            slot_f = jf_c % R
+            prev = jax.lax.dynamic_index_in_dim(ring, slot_f, 0, keepdims=False)
+            ring = jax.lax.dynamic_update_index_in_dim(
+                ring, jnp.where(active_f, x_in, prev), slot_f, 0
+            )
+            tgt = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, jf_c, 0, keepdims=False),
+                local_tm,
+            )
+            # per-microbatch loss + cotangent — the last stage turns the
+            # microbatch around within this same tick
+            l_j, dy_j = jax.value_and_grad(lambda yy: loss_fn(yy, tgt))(y)
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(active_f, is_last), l_j, 0.0
+            )
+            # ---- backward sub-phase: microbatch jb = t - (2S-2-stage) -- #
+            jb = t - (2 * S - 2 - stage)
+            active_b = jnp.logical_and(jb >= 0, jb < M)
+            jb_c = jnp.clip(jb, 0, M - 1)
+            x_saved = jax.lax.dynamic_index_in_dim(ring, jb_c % R, 0, keepdims=False)
+            # on the last stage jb == jf at every active bwd tick, so dy_j
+            # computed above IS the cotangent for jb
+            ct = jnp.where(is_last, dy_j, bwd_msg)
+            _, vjp_fn = jax.vjp(block_fn, local_params, x_saved)
+            dp, dx = vjp_fn(ct.astype(y.dtype))
+            dparams = jax.tree.map(
+                lambda acc, g: acc + jnp.where(active_b, g, 0.0), dparams, dp
+            )
+            # ---- rotate messages --------------------------------------- #
+            fwd_msg = jax.lax.ppermute(y, MESH_AXIS_PIPELINE, fwd_perm)
+            bwd_msg = jax.lax.ppermute(dx, MESH_AXIS_PIPELINE, bwd_perm)
+            return (fwd_msg, bwd_msg, ring, dparams, loss_acc), None
+
+        mb = local_xm[0]
+        init = (
+            jnp.zeros_like(mb),
+            jnp.zeros_like(mb),
+            jnp.zeros((R,) + mb.shape, mb.dtype),
+            jax.tree.map(jnp.zeros_like, local_params),
+            jnp.zeros((), jnp.float32),
+        )
+        (f_msg, b_msg, ring, dparams, loss_acc), _ = jax.lax.scan(
+            tick, init, jnp.arange(T)
+        )
+        loss = jax.lax.psum(loss_acc, MESH_AXIS_PIPELINE) / M
+        dparams = jax.tree.map(lambda g: g / M, dparams)
+        return loss, dparams
+
+    return _run(stacked_params, xm, tm)
