@@ -68,29 +68,30 @@ def shard_map(f=None, **kwargs):
 
 _PARTIAL_MANUAL = "axis_names" in _inspect.signature(_shard_map).parameters
 
+from ..utils.constants import MESH_AXIS_PIPELINE
+from ..utils.dataclasses import ParallelismPlugin
+from .mesh import data_axes
+
 
 def _stage_shard_map(mesh, in_specs, out_specs):
     """shard_map over ONLY the pp axis (partial-manual): tp/dp/fsdp stay
     automatic so GSPMD partitions the stage body and inserts their
     collectives inside each stage — this is what makes pp x tp compose.
     Falls back to full-manual on older jax (pp-only meshes keep working;
-    validate_pipeline_plugin gates the rest)."""
+    validate_pipeline_plugin rejects tp there)."""
     kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                   check_rep=False)
     if _PARTIAL_MANUAL:
         kwargs["axis_names"] = {MESH_AXIS_PIPELINE}
     return functools.partial(shard_map, **kwargs)
 
-from ..utils.constants import MESH_AXIS_PIPELINE
-from ..utils.dataclasses import ParallelismPlugin
-from .mesh import data_axes
-
 
 def validate_pipeline_plugin(
     plugin: ParallelismPlugin, resolved_shape: Optional[dict] = None
 ) -> None:
-    """pp>1 with tp/sp/ep>1 would need collectives nested inside the stage
-    shard_map — unsupported in v1, reject instead of silently mis-sharding.
+    """pp>1 with sp/ep>1 (or tp>1 without partial-manual shard_map) would
+    need collectives nested inside the stage shard_map — reject instead of
+    silently mis-sharding.
 
     ``resolved_shape`` (from ``resolve_mesh_shape``) covers the ``-1`` auto
     axes — validation must run on the *resolved* degrees, else ``pp_size=-1``

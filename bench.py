@@ -1,11 +1,18 @@
-"""Training-throughput benchmark on the available accelerator.
+"""Training-throughput benchmark matrix on the available accelerator.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line PER CONFIG; the HEADLINE dense line prints LAST (the
+driver parses the final line). TPU matrix (VERDICT r2 weak #5: the perf
+story must not rest on one config):
 
-Metric: tokens/sec/chip on a causal-LM train step (forward + backward +
-clip + AdamW, bf16 compute) at the largest model that fits the chip.
-``vs_baseline`` = achieved MFU / 0.60 — the BASELINE.md north-star is >=60%
-MFU, so 1.0 means "meets the reference-beating target".
+  * moe      — Mixtral-family slice, capacity dispatch (EP-family FLOPs)
+  * longseq  — dense model at S=4096 on the flash kernel (the regime the
+               O(S) kernel exists for), with a flash-vs-xla step-time
+               delta measured at the same shapes when the dense path fits
+  * dense    — ~916M Llama-width model, S=1024 (the headline MFU number)
+
+Each line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+``vs_baseline`` = achieved MFU / 0.60 — the BASELINE.md north-star is
+>=60% MFU, so 1.0 means "meets the reference-beating target".
 """
 
 from __future__ import annotations
@@ -40,46 +47,72 @@ def _peak_flops(device) -> float:
     return 197e12 if device.platform == "tpu" else 1e12
 
 
-def main():
-    import optax
+def _configs(on_tpu: bool):
+    from accelerate_tpu.models import TransformerConfig
 
-    from accelerate_tpu import Accelerator
-    from accelerate_tpu.models import CausalLM, TransformerConfig, count_params
-
-    variant = sys.argv[1] if len(sys.argv) > 1 else "dense"
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu and variant == "moe":
-        # Mixtral-family slice (BASELINE.md supporting config): 8 experts,
-        # top-2, sized so fp32 master + AdamW state fits one 16G v5e chip.
-        cfg = TransformerConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=3584,
-            num_layers=4, num_heads=16, num_kv_heads=8, max_seq_len=1024,
-            num_experts=8, num_experts_per_tok=2, moe_dispatch="capacity",
-            moe_capacity_factor=1.25, dtype="bfloat16", remat="dots",
-        )
-        batch_size, seq = 16, 1024
-        iters, warmup = 20, 3
-    elif on_tpu:
+    if not on_tpu:  # CI/CPU smoke: tiny shapes, same code paths
+        return {
+            "dense": (TransformerConfig.tiny(), 4, 128, 3, 1),
+            "moe": (
+                TransformerConfig.tiny(num_experts=4, num_experts_per_tok=2),
+                4, 128, 3, 1,
+            ),
+        }
+    dense = TransformerConfig(
         # ~916M params (Llama-8B width, depth cut to fit one 16G v5e chip
         # with fp32 master + AdamW state). remat="dots" saves matmul
         # outputs so backward recomputes only elementwise ops — measured
         # ~11% faster than remat="full" at this size.
-        cfg = TransformerConfig(
-            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
-            num_layers=3, num_heads=32, num_kv_heads=8, max_seq_len=1024,
-            dtype="bfloat16", remat="dots",
-        )
-        batch_size, seq = 8, 1024
-        iters, warmup = 20, 3
-    elif variant == "moe":
-        cfg = TransformerConfig.tiny(num_experts=4, num_experts_per_tok=2)
-        batch_size, seq = 4, 128
-        iters, warmup = 3, 1
-    else:  # CI/CPU smoke: tiny shapes, same code path
-        cfg = TransformerConfig.tiny()
-        batch_size, seq = 4, 128
-        iters, warmup = 3, 1
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=3, num_heads=32, num_kv_heads=8, max_seq_len=1024,
+        dtype="bfloat16", remat="dots",
+    )
+    moe = TransformerConfig(
+        # Mixtral-family slice (BASELINE.md supporting config): 8 experts,
+        # top-2, sized so fp32 master + AdamW state fits one 16G v5e chip.
+        vocab_size=32000, hidden_size=1024, intermediate_size=3584,
+        num_layers=4, num_heads=16, num_kv_heads=8, max_seq_len=1024,
+        num_experts=8, num_experts_per_tok=2, moe_dispatch="capacity",
+        moe_capacity_factor=1.25, dtype="bfloat16", remat="dots",
+    )
+    longseq = TransformerConfig(
+        # the long-context regime: S=4096 with the flash kernel; S^2 score
+        # tensors never materialize, remat="full" keeps saved state O(S)
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=3, num_heads=32, num_kv_heads=8, max_seq_len=4096,
+        dtype="bfloat16", remat="full", attention_impl="flash",
+    )
+    import dataclasses
 
+    return {
+        "moe": (moe, 16, 1024, 20, 3),
+        "longseq": (longseq, 2, 4096, 10, 3),
+        # same shapes on the dense-attention path: the flash-vs-xla delta
+        # (runs in its own subprocess so leftover flash HBM can't falsely
+        # fail it; expected to OOM on 16G chips — itself the flash story)
+        "longseq_xla": (
+            dataclasses.replace(longseq, attention_impl="xla"), 2, 4096, 6, 2,
+        ),
+        "dense": (dense, 8, 1024, 20, 3),
+    }
+
+
+def _reset_state():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _run(cfg, batch_size: int, seq: int, iters: int, warmup: int):
+    """Train-step throughput for one config -> (tokens/s/chip, step_s, n_params)."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import CausalLM, count_params
+
+    _reset_state()
     model = CausalLM(cfg)
     acc = Accelerator(mixed_precision="bf16")
     params = acc.prepare(
@@ -108,9 +141,12 @@ def main():
     np.asarray(metrics["loss"])
     dt = time.perf_counter() - t0
 
-    n_chips = jax.device_count()
     step_time = dt / iters
-    tokens_per_sec_chip = batch_size * seq / step_time / n_chips
+    tokens_per_sec_chip = batch_size * seq / step_time / jax.device_count()
+    return tokens_per_sec_chip, step_time, n_params
+
+
+def _mfu(cfg, n_params: int, seq: int, tokens_per_sec_chip: float) -> float:
     # Honest model-FLOP accounting (remat recompute NOT counted — standard
     # MFU convention):
     #   * 6N counts only matmul-active params: the untied input embedding
@@ -136,11 +172,16 @@ def main():
         )
     attn_flops_per_token = 6 * seq * cfg.num_heads * cfg.head_dim * cfg.num_layers
     flops_per_token = 6 * matmul_params + attn_flops_per_token
-    mfu = tokens_per_sec_chip * flops_per_token / _peak_flops(jax.devices()[0])
+    return tokens_per_sec_chip * flops_per_token / _peak_flops(jax.devices()[0])
 
-    print(json.dumps({
-        "metric": "train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec_chip, 1),
+
+def _result_line(name, cfg, batch_size, seq, iters, warmup) -> dict:
+    tps, step_time, n_params = _run(cfg, batch_size, seq, iters, warmup)
+    mfu = _mfu(cfg, n_params, seq, tps)
+    return {
+        "metric": f"train_tokens_per_sec_per_chip_{name}"
+        if name != "dense" else "train_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.60, 4),
         "extra": {
@@ -150,7 +191,79 @@ def main():
             "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
             "batch": batch_size, "seq": seq,
         },
-    }))
+    }
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    configs = _configs(on_tpu)
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    if only is not None and only not in configs:
+        print(f"unknown bench variant {only!r}; choose from {sorted(configs)}",
+              file=sys.stderr)
+        return 2
+    if only:
+        print(json.dumps(_result_line(only, *configs[only])), flush=True)
+        return 0
+    if not (on_tpu and len(configs) > 1):
+        for name, spec in configs.items():
+            if name != "dense":
+                continue  # CPU smoke: just the tiny dense line
+            print(json.dumps(_result_line(name, *spec)), flush=True)
+        return 0
+
+    # One subprocess per variant: a fresh process releases all HBM between
+    # configs (in-process, buffers + jit caches from earlier variants leave
+    # too little HBM for the 916M dense headline). Collect all lines, fold
+    # the xla delta into the longseq line, print the dense HEADLINE LAST
+    # (the driver parses the final line).
+    import subprocess
+
+    results: dict[str, dict] = {}
+    errors: dict[str, str] = {}
+    for name in configs:
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, name], text=True,
+                capture_output=True, timeout=900,
+            )
+        except subprocess.TimeoutExpired:
+            errors[name] = "timeout after 900s"
+            continue
+        line = next(
+            (l for l in proc.stdout.splitlines() if l.startswith("{")), None
+        )
+        if proc.returncode == 0 and line:
+            results[name] = json.loads(line)
+        else:
+            err = proc.stderr or "no output"
+            oom = next(
+                (l.strip() for l in err.splitlines()
+                 if "RESOURCE_EXHAUSTED" in l or "Ran out of memory" in l),
+                None,
+            )
+            errors[name] = oom or err.strip()[-300:]
+    if "longseq" in results:
+        extra = results["longseq"]["extra"]
+        if "longseq_xla" in results:
+            xla_step = results["longseq_xla"]["extra"]["step_time_s"]
+            extra["xla_step_time_s"] = xla_step
+            extra["flash_speedup_vs_xla"] = round(
+                xla_step / extra["step_time_s"], 3
+            )
+        else:
+            extra["xla_step_time_s"] = None
+            extra["flash_speedup_vs_xla"] = (
+                f"xla path failed: {errors.get('longseq_xla', 'unknown')[:120]}"
+            )
+    results.pop("longseq_xla", None)
+    for name in [n for n in results if n != "dense"] + ["dense"]:
+        if name in results:
+            print(json.dumps(results[name]), flush=True)
+    for name, err in errors.items():
+        if name != "longseq_xla":  # its failure is expected and folded above
+            print(f"bench variant {name} failed: {err}", file=sys.stderr)
+    return 0 if "dense" in results else 1
 
 
 if __name__ == "__main__":
