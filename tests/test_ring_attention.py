@@ -65,3 +65,51 @@ def test_ring_falls_back_without_sp():
     out = ring_attention(q, k, v, causal=True, mesh=acc.mesh)
     ref = xla_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5)
+
+
+@pytest.mark.slow
+def test_ring_32k_sp8_no_dense_fallback(monkeypatch):
+    """The long-context proof point (VERDICT r2 #10): S=32768 over an sp=8
+    ring on the CPU mesh. The dense path is monkeypatched to explode, so
+    passing PROVES the ring ran (a dense fallback would also need a 4 GiB
+    score matrix). Correctness via a row-subset oracle: full dense logits
+    for sampled query rows — a complete dense reference at 32k is
+    infeasible by design."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(
+        parallelism_plugin=ParallelismPlugin(dp_size=1, sp_size=8)
+    )
+    mesh = acc.mesh
+    S, B, H, D = 32768, 1, 1, 8
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    sh = NamedSharding(mesh, P(None, "sp"))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+
+    import accelerate_tpu.ops.attention as attn_mod
+
+    def _no_dense(*a, **kw):
+        raise AssertionError("ring_attention took the dense fallback at 32k")
+
+    monkeypatch.setattr(attn_mod, "xla_attention", _no_dense)
+    out = np.asarray(
+        jax.jit(lambda q, k, v: ring_attention(q, k, v, causal=True, mesh=mesh))(
+            q, k, v
+        )
+    )
+
+    scale = D ** -0.5
+    rows = np.sort(rng.choice(S, 16, replace=False))
+    kn, vn, qn = np.asarray(k), np.asarray(v), np.asarray(q)
+    for i in rows:
+        logits = (qn[0, i, 0] @ kn[0, : i + 1, 0].T) * scale
+        w = np.exp(logits - logits.max())
+        w /= w.sum()
+        ref = w @ vn[0, : i + 1, 0]
+        np.testing.assert_allclose(out[0, i, 0], ref, rtol=2e-4, atol=2e-5)
