@@ -38,7 +38,10 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--config_file", default=None)
     parser.add_argument("--num_machines", type=int, default=None,
                         help="Number of hosts (JAX processes)")
-    parser.add_argument("--machine_rank", type=int, default=None)
+    parser.add_argument("--machine_rank", type=int, default=None,
+                        help="This host's pod worker index; -1 = infer "
+                        "from TPU_WORKER_ID / hostname (errors if neither "
+                        "yields one)")
     parser.add_argument("--main_process_ip", default=None)
     parser.add_argument("--main_process_port", type=int, default=None)
     parser.add_argument("--mixed_precision", default=None,
@@ -50,6 +53,21 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--sharding_strategy", default=None)
     parser.add_argument("--debug_num_processes", type=int, default=None,
                         help="Spawn N local CPU processes (debug/test mode)")
+    def _non_negative(val: str) -> int:
+        n = int(val)
+        if n < 0:
+            raise argparse.ArgumentTypeError(
+                "--max_restarts must be >= 0 (there is no 'infinite' mode)"
+            )
+        return n
+
+    parser.add_argument("--max_restarts", type=_non_negative, default=0,
+                        help="Supervised retry: relaunch a crashed training "
+                        "script up to N times (pair with CheckpointManager "
+                        "auto-resume; reference torchelastic max_restarts)")
+    parser.add_argument("--monitor_interval", type=float, default=5.0,
+                        help="Seconds to wait before each relaunch "
+                        "(reference torchelastic monitor_interval)")
     parser.add_argument("--gcloud", action="store_true",
                         help="Fan out to all pod workers via gcloud ssh")
     parser.add_argument("--tpu_name", default=None)
@@ -79,17 +97,54 @@ def _merge_config(args) -> ClusterConfig:
         val = getattr(args, f"{axis}_size", None)
         if val is not None:
             setattr(cfg, f"{axis}_size", val)
+    if getattr(args, "machine_rank", None) == -1:
+        # explicit "infer on this worker" sentinel (the pod fan-out uses
+        # it): derive from the TPU runtime env, raising loudly on failure
+        cfg.machine_rank = infer_machine_rank()
+    elif (
+        cfg.num_machines > 1
+        and getattr(args, "machine_rank", None) is None
+        and any(v in os.environ for v in ("TPU_WORKER_ID", "CLOUD_TPU_TASK_ID"))
+    ):
+        # multi-host with no explicit rank but a TPU runtime present:
+        # trust the runtime's worker id over the config-file default
+        cfg.machine_rank = infer_machine_rank()
     return cfg
 
 
 def simple_launcher(args, cfg: ClusterConfig) -> int:
-    """Single host: exec the script with the env transport (reference :696)."""
+    """Single host: exec the script with the env transport (reference :696).
+
+    With ``--max_restarts N``, a crashed script is relaunched up to N
+    times (reference passes torchelastic ``max_restarts``/
+    ``monitor_interval``, launchers.py:226-239). The restarted run resumes
+    from the latest complete checkpoint when the script uses
+    :class:`~accelerate_tpu.fault_tolerance.CheckpointManager.restore_or_init`
+    — together they form the supervised-elastic loop. The attempt index is
+    exported as ``ACCELERATE_TPU_RESTART_COUNT``.
+    """
+    import time
+
     env = {**os.environ, **cfg.to_env()}
     if cfg.num_machines > 1:
         env[ENV_PREFIX + "NUM_PROCESSES"] = str(cfg.num_machines)
         env[ENV_PREFIX + "PROCESS_ID"] = str(cfg.machine_rank)
     cmd = [sys.executable, args.training_script, *args.training_script_args]
-    return subprocess.call(cmd, env=env)
+    max_restarts = getattr(args, "max_restarts", 0) or 0
+    for attempt in range(max_restarts + 1):
+        env[ENV_PREFIX + "RESTART_COUNT"] = str(attempt)
+        rc = subprocess.call(cmd, env=env)
+        if rc == 0:
+            return 0
+        if attempt < max_restarts:
+            delay = getattr(args, "monitor_interval", 5.0)
+            print(
+                f"training script exited with {rc}; restart "
+                f"{attempt + 1}/{max_restarts} in {delay}s",
+                file=sys.stderr,
+            )
+            time.sleep(delay)
+    return rc
 
 
 def debug_launcher_command(args, cfg: ClusterConfig) -> int:
@@ -119,14 +174,48 @@ def debug_launcher_command(args, cfg: ClusterConfig) -> int:
     return rc
 
 
+def infer_machine_rank() -> int:
+    """This host's pod worker index (reference derives it host-side,
+    commands/launch.py:827-885).
+
+    Priority: the TPU runtime's own worker id (``TPU_WORKER_ID``, set on
+    every Cloud TPU VM; ``CLOUD_TPU_TASK_ID`` on older images) — then the
+    ``-w-{i}`` hostname suffix GCE gives TPU VM workers. A bare trailing
+    digit (e.g. a custom DNS name ``ml-node-7``) is NOT a worker index and
+    raises: a silently wrong rank makes workers collide at coordinator
+    init and hangs the whole pod.
+    """
+    import re
+    import socket
+
+    for var in ("TPU_WORKER_ID", "CLOUD_TPU_TASK_ID"):
+        val = os.environ.get(var)
+        if val is not None and val.strip().isdigit():
+            return int(val)
+    hostname = socket.gethostname()
+    m = re.search(r"-w-(\d+)$", hostname)
+    if m:
+        return int(m.group(1))
+    raise RuntimeError(
+        f"cannot derive --machine_rank: TPU_WORKER_ID/CLOUD_TPU_TASK_ID "
+        f"unset and hostname {hostname!r} has no '-w-<index>' suffix — "
+        "pass --machine_rank explicitly"
+    )
+
+
 def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
     """Fan the same launch out to every pod worker over gcloud ssh
-    (reference tpu_pod_launcher :827 / tpu.py:90)."""
+    (reference tpu_pod_launcher :827 / tpu.py:90). Each worker derives its
+    own rank host-side via :func:`infer_machine_rank` (TPU_WORKER_ID with
+    an erroring hostname fallback — the r2 hostname regex produced an
+    empty rank on non-standard names with no error)."""
     from .tpu import build_gcloud_ssh_command
 
     inner = (
         f"cd {os.getcwd()} && "
-        f"accelerate-tpu launch --machine_rank $(hostname | grep -o '[0-9]*$') "
+        f"accelerate-tpu launch --machine_rank -1 "
+        f"--max_restarts {getattr(args, 'max_restarts', 0) or 0} "
+        f"--monitor_interval {getattr(args, 'monitor_interval', 5.0)} "
         f"{args.training_script} {' '.join(args.training_script_args)}"
     )
     cmd = build_gcloud_ssh_command(

@@ -75,11 +75,6 @@ def test_ring_32k_sp8_no_dense_fallback(monkeypatch):
     score matrix). Correctness via a row-subset oracle: full dense logits
     for sampled query rows — a complete dense reference at 32k is
     infeasible by design."""
-    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
-
-    AcceleratorState._reset_state()
-    GradientState._reset_state()
-    PartialState._reset_state()
     acc = Accelerator(
         parallelism_plugin=ParallelismPlugin(dp_size=1, sp_size=8)
     )
