@@ -65,7 +65,13 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         help="Supervised retry: relaunch a crashed training "
                         "script up to N times (pair with CheckpointManager "
                         "auto-resume; reference torchelastic max_restarts)")
-    parser.add_argument("--monitor_interval", type=float, default=5.0,
+    def _non_negative_f(val: str) -> float:
+        x = float(val)
+        if x < 0:
+            raise argparse.ArgumentTypeError("--monitor_interval must be >= 0")
+        return x
+
+    parser.add_argument("--monitor_interval", type=_non_negative_f, default=5.0,
                         help="Seconds to wait before each relaunch "
                         "(reference torchelastic monitor_interval)")
     parser.add_argument("--gcloud", action="store_true",
@@ -211,18 +217,36 @@ def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
     empty rank on non-standard names with no error)."""
     from .tpu import build_gcloud_ssh_command
 
+    # NO per-worker restart flags: a single restarted worker cannot rejoin
+    # a live jax.distributed job (the coordinator holds the original
+    # generation's ranks) — one rejoining process would hang the pod.
+    # Supervision happens HERE instead: the whole fan-out (every worker
+    # together) is relaunched, so the coordinator re-forms cleanly.
     inner = (
         f"cd {os.getcwd()} && "
         f"accelerate-tpu launch --machine_rank -1 "
-        f"--max_restarts {getattr(args, 'max_restarts', 0) or 0} "
-        f"--monitor_interval {getattr(args, 'monitor_interval', 5.0)} "
         f"{args.training_script} {' '.join(args.training_script_args)}"
     )
     cmd = build_gcloud_ssh_command(
         cfg.tpu_name or "tpu", inner, cfg.tpu_zone
     )
     print("Running:", " ".join(cmd))
-    return subprocess.call(cmd)
+    import time
+
+    max_restarts = getattr(args, "max_restarts", 0) or 0
+    for attempt in range(max_restarts + 1):
+        rc = subprocess.call(cmd)
+        if rc == 0:
+            return 0
+        if attempt < max_restarts:
+            delay = getattr(args, "monitor_interval", 5.0)
+            print(
+                f"pod launch exited with {rc}; whole-pod restart "
+                f"{attempt + 1}/{max_restarts} in {delay}s",
+                file=sys.stderr,
+            )
+            time.sleep(delay)
+    return rc
 
 
 def launch_command(args) -> None:

@@ -1,0 +1,257 @@
+"""Feature example: automatic gradient accumulation.
+
+Combines ``find_executable_batch_size`` (OOM-halving retry,
+utils/memory.py — the reference's automatic batch-size finder) with
+gradient accumulation computed AUTOMATICALLY: pick a target OBSERVED
+(global) batch size; the decorator finds the largest per-step batch that
+fits the chip, and ``gradient_accumulation_steps`` is derived as
+``target // found`` so the effective optimizer batch stays constant
+regardless of hardware (reference
+``examples/by_feature/automatic_gradient_accumulation.py``).
+"""
+
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from torch.utils.data import DataLoader
+
+# Allow running by path without a pip install: put the repo root on sys.path
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+)
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.memory import find_executable_batch_size
+from accelerate_tpu.models import SequenceClassifier, TransformerConfig
+from accelerate_tpu.utils.random import set_seed
+
+########################################################################
+# This is a fully working simple example to use accelerate_tpu.
+#
+# This example trains a BERT-base-shaped encoder on a paraphrase
+# detection task (MRPC format) in any of the following settings
+# (with the same script):
+#   - single TPU chip
+#   - TPU pod slice (multi-chip, data parallel)
+#   - CPU (virtual device mesh)
+#   - bf16 / fp16 (mixed-precision) or fp32 (normal precision)
+########################################################################
+
+MAX_SEQ_LENGTH = 128
+EVAL_BATCH_SIZE = 32
+PAD, CLS, SEP = 0, 1, 2
+
+
+def make_paraphrase_dataset(num_examples: int, seed: int, vocab_size: int):
+    """Deterministic MRPC-shaped sentence-pair data (hub-free: the real
+    GLUE/MRPC download needs network access). Label 1 = sentence2 is a
+    shuffled light edit of sentence1; label 0 = unrelated sentence."""
+    rng = np.random.default_rng(seed)
+    examples = []
+    for _ in range(num_examples):
+        length = int(rng.integers(8, 24))
+        sentence1 = rng.integers(4, vocab_size, length)
+        if rng.random() < 0.5:
+            sentence2 = sentence1.copy()
+            rng.shuffle(sentence2)
+            n_edit = max(1, length // 8)
+            idx = rng.choice(length, n_edit, replace=False)
+            sentence2[idx] = rng.integers(4, vocab_size, n_edit)
+            label = 1
+        else:
+            sentence2 = rng.integers(4, vocab_size, int(rng.integers(8, 24)))
+            label = 0
+        examples.append((sentence1, sentence2, label))
+    return examples
+
+
+def tokenize_pair(sentence1, sentence2, label):
+    """[CLS] s1 [SEP] s2 [SEP], padded to MAX_SEQ_LENGTH."""
+    ids = [CLS, *sentence1.tolist(), SEP, *sentence2.tolist(), SEP]
+    ids = ids[:MAX_SEQ_LENGTH]
+    attention_mask = [1] * len(ids) + [0] * (MAX_SEQ_LENGTH - len(ids))
+    ids = ids + [PAD] * (MAX_SEQ_LENGTH - len(ids))
+    return {
+        "input_ids": np.asarray(ids, np.int32),
+        "attention_mask": np.asarray(attention_mask, np.int32),
+        "labels": np.int32(label),
+    }
+
+
+def collate_fn(items):
+    return {
+        key: np.stack([item[key] for item in items]) for key in items[0]
+    }
+
+
+def get_dataloaders(accelerator: Accelerator, batch_size: int = 16,
+                    model_config: TransformerConfig = None):
+    """Build train/eval DataLoaders for the paraphrase task.
+
+    These are plain ``torch.utils.data.DataLoader`` objects — exactly what
+    a raw host-side script would already have; ``accelerator.prepare``
+    turns them into sharded, prefetching device loaders.
+    """
+    vocab_size = model_config.vocab_size if model_config is not None else 30522
+    n_train = 2048 if os.environ.get("TESTING_TINY_MODEL") else 16384
+    train_examples = make_paraphrase_dataset(n_train, seed=1234, vocab_size=vocab_size)
+    eval_examples = make_paraphrase_dataset(n_train // 4, seed=5678, vocab_size=vocab_size)
+    train_dataset = [tokenize_pair(*ex) for ex in train_examples]
+    eval_dataset = [tokenize_pair(*ex) for ex in eval_examples]
+
+    train_dataloader = DataLoader(
+        train_dataset, shuffle=True, collate_fn=collate_fn,
+        batch_size=batch_size, drop_last=True,
+    )
+    eval_dataloader = DataLoader(
+        eval_dataset, shuffle=False, collate_fn=collate_fn,
+        batch_size=EVAL_BATCH_SIZE, drop_last=False,
+    )
+    return train_dataloader, eval_dataloader
+
+
+def training_function(config, args):
+    # The DESIRED effective optimizer batch; per-step batch and accumulation
+    # are derived automatically below
+    observed_batch_size = int(args.observed_batch_size)
+    # Sample hyper-parameters for learning rate, batch size, seed and a few others
+    lr = config["lr"]
+    seed = int(config["seed"])
+    starting_batch_size = int(config["batch_size"])
+
+    set_seed(seed)
+
+    # New Code: the decorator retries the whole training body with a halved
+    # batch size whenever the accelerator reports an out-of-memory error;
+    # accumulation steps then scale back up so the effective batch is fixed
+    @find_executable_batch_size(starting_batch_size=starting_batch_size)
+    def inner_training_loop(batch_size):
+        # a fresh retry reconfigures the accelerator for the new
+        # accumulation factor — clear the singletons from the failed try
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        num_epochs = int(config["num_epochs"])
+        gradient_accumulation_steps = max(observed_batch_size // batch_size, 1)
+        accelerator = Accelerator(
+            cpu=args.cpu,
+            mixed_precision=args.mixed_precision,
+            gradient_accumulation_steps=gradient_accumulation_steps,
+        )
+        accelerator.print(
+            f"per-step batch {batch_size} x accumulation "
+            f"{gradient_accumulation_steps} = effective "
+            f"{batch_size * gradient_accumulation_steps}"
+        )
+        # Instantiate the model config; BERT-base shape unless testing tiny
+        model_config = TransformerConfig.bert_base(dtype=compute_dtype(accelerator))
+        if os.environ.get("TESTING_TINY_MODEL"):
+            model_config = TransformerConfig.tiny(causal=False, dtype=compute_dtype(accelerator))
+            num_epochs = int(os.environ.get("TESTING_NUM_EPOCHS", num_epochs))
+        train_dataloader, eval_dataloader = get_dataloaders(accelerator, batch_size, model_config)
+        model = SequenceClassifier(model_config, num_labels=2)
+        variables = model.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, MAX_SEQ_LENGTH), jnp.int32),
+            jnp.ones((1, MAX_SEQ_LENGTH), jnp.int32),
+        )
+
+        # Instantiate the optimizer with a linear warmup-decay schedule
+        steps_per_epoch = len(train_dataloader)
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=steps_per_epoch // 4,
+            decay_steps=steps_per_epoch * num_epochs // gradient_accumulation_steps,
+        )
+        optimizer = optax.adamw(schedule, weight_decay=0.01)
+
+        # Prepare everything: params get sharded over the mesh, the optimizer
+        # state is init'd congruent with them, loaders yield global batches.
+        # There is no specific order to remember, we just need to unpack the
+        # objects in the same order we gave them to the prepare method.
+        params, optimizer, train_dataloader, eval_dataloader = accelerator.prepare(
+            variables["params"], optimizer, train_dataloader, eval_dataloader
+        )
+
+        # The fused train step: forward+backward+clip+update, one XLA program
+        carry = accelerator.init_carry(params, optimizer)
+        train_step = accelerator.unified_step(
+            SequenceClassifier.loss_fn(model), max_grad_norm=1.0
+        )
+
+        @jax.jit
+        def eval_step(params, batch):
+            logits = model.apply(
+                {"params": params}, batch["input_ids"], batch["attention_mask"]
+            )
+            return jnp.argmax(logits, axis=-1)
+
+        # Now we train the model
+        for epoch in range(num_epochs):
+            for step, batch in enumerate(train_dataloader):
+                carry, metrics = train_step(carry, batch)
+                if step % 50 == 0:
+                    # periodic host read: live progress, and it bounds the async
+                    # dispatch queue (deep queues of collective programs can
+                    # starve XLA:CPU's rendezvous on small test hosts)
+                    accelerator.print(
+                        f"epoch {epoch} step {step}: loss {float(metrics['loss']):.4f}"
+                    )
+            # reading the loss drains the step pipeline before eval compilation
+            train_loss = float(metrics["loss"])
+
+            correct = total = 0
+            for step, batch in enumerate(eval_dataloader):
+                predictions = eval_step(carry["params"], batch)
+                predictions, references = accelerator.gather_for_metrics(
+                    (predictions, batch["labels"])
+                )
+                correct += int(np.sum(np.asarray(predictions) == np.asarray(references)))
+                total += int(np.asarray(references).shape[0])
+            eval_metric = {"accuracy": correct / max(total, 1)}
+            # Use accelerator.print to print only on the main process.
+            accelerator.print(f"epoch {epoch}: train_loss {train_loss:.4f}", eval_metric)
+        return eval_metric
+
+    return inner_training_loop()
+
+
+def compute_dtype(accelerator: Accelerator) -> str:
+    """Activation dtype for the model from the accelerator's policy."""
+    return jnp.dtype(accelerator.state.mixed_precision_policy.compute_dtype).name
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Simple example of training script.")
+    parser.add_argument(
+        "--mixed_precision",
+        type=str,
+        default=None,
+        choices=["no", "fp16", "bf16", "fp8"],
+        help="Whether to use mixed precision. Choose"
+        "between fp16 and bf16 (bfloat16). Bf16 is the TPU-native choice.",
+    )
+    parser.add_argument("--cpu", action="store_true", help="If passed, will train on the CPU.")
+    parser.add_argument(
+        "--observed_batch_size",
+        type=int,
+        default=64,
+        help="Target effective optimizer batch; per-step batch and "
+        "accumulation steps are derived automatically.",
+    )
+    args = parser.parse_args()
+    config = {"lr": 2e-4, "num_epochs": 3, "seed": 42, "batch_size": 16}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,26 +1,13 @@
-"""Feature example: experiment tracking.
+"""Feature example: k-fold cross validation.
 
-Initializes the Accelerator with ``log_with`` + ``project_dir``, stores the
-run config with ``init_trackers``, logs per-epoch metrics with
-``accelerator.log`` and closes trackers with ``end_training`` (reference
-``examples/by_feature/tracking.py``).
-
-TPU-native counterpart of reference ``examples/nlp_example.py`` (BERT on
-GLUE/MRPC): a BERT-base-shaped :class:`SequenceClassifier` fine-tuned on an
-MRPC-style paraphrase-detection task, runnable unchanged on
-
-  - a single TPU chip,
-  - a TPU pod slice (data-parallel over the mesh),
-  - CPU (virtual multi-device mesh for tests).
-
-The data pipeline is hub-free (no network): a deterministic MRPC-shaped
-paraphrase dataset is synthesized locally, through a plain
-``torch.utils.data.DataLoader`` — the user's host-side loader survives
-as-is; ``accelerator.prepare`` turns it into globally-sharded device
-batches. The training loop is the JAX raw loop: ``prepare`` the params /
-optimizer / loaders, build the fused train step with
-``accelerator.unified_step``, iterate.
+Trains K models over K folds of the dataset and ensembles the held-out
+predictions (reference ``examples/by_feature/cross_validation.py``
+stratifies MRPC with sklearn; here the folds are deterministic slices of
+the synthetic paraphrase dataset). Each fold gets a fresh Accelerator —
+the singleton state resets between folds, the pattern for any
+multi-trial sweep in one process.
 """
+
 
 import argparse
 import os
@@ -102,43 +89,50 @@ def collate_fn(items):
     }
 
 
-def get_dataloaders(accelerator: Accelerator, batch_size: int = 16,
-                    model_config: TransformerConfig = None):
-    """Build train/eval DataLoaders for the paraphrase task.
+def get_fold_dataloaders(accelerator: Accelerator, fold: int, num_folds: int,
+                         batch_size: int = 16,
+                         model_config: TransformerConfig = None):
+    """New Code: DataLoaders for fold ``fold`` of ``num_folds``.
 
-    These are plain ``torch.utils.data.DataLoader`` objects — exactly what
-    a raw host-side script would already have; ``accelerator.prepare``
-    turns them into sharded, prefetching device loaders.
+    The dataset is cut into ``num_folds`` contiguous validation slices;
+    fold i trains on everything outside slice i and validates on it. A
+    shared held-out TEST slice (generated with a different seed) receives
+    each fold model's predictions for the final ensemble.
     """
     vocab_size = model_config.vocab_size if model_config is not None else 30522
     n_train = 2048 if os.environ.get("TESTING_TINY_MODEL") else 16384
-    train_examples = make_paraphrase_dataset(n_train, seed=1234, vocab_size=vocab_size)
-    eval_examples = make_paraphrase_dataset(n_train // 4, seed=5678, vocab_size=vocab_size)
-    train_dataset = [tokenize_pair(*ex) for ex in train_examples]
-    eval_dataset = [tokenize_pair(*ex) for ex in eval_examples]
+    examples = make_paraphrase_dataset(n_train, seed=1234, vocab_size=vocab_size)
+    test_examples = make_paraphrase_dataset(n_train // 4, seed=5678, vocab_size=vocab_size)
+    dataset = [tokenize_pair(*ex) for ex in examples]
+    fold_size = len(dataset) // num_folds
+    lo, hi = fold * fold_size, (fold + 1) * fold_size
+    train_dataset = dataset[:lo] + dataset[hi:]
+    valid_dataset = dataset[lo:hi]
+    test_dataset = [tokenize_pair(*ex) for ex in test_examples]
 
     train_dataloader = DataLoader(
         train_dataset, shuffle=True, collate_fn=collate_fn,
         batch_size=batch_size, drop_last=True,
     )
-    eval_dataloader = DataLoader(
-        eval_dataset, shuffle=False, collate_fn=collate_fn,
+    valid_dataloader = DataLoader(
+        valid_dataset, shuffle=False, collate_fn=collate_fn,
         batch_size=EVAL_BATCH_SIZE, drop_last=False,
     )
-    return train_dataloader, eval_dataloader
+    test_dataloader = DataLoader(
+        test_dataset, shuffle=False, collate_fn=collate_fn,
+        batch_size=EVAL_BATCH_SIZE, drop_last=False,
+    )
+    return train_dataloader, valid_dataloader, test_dataloader
 
 
-def training_function(config, args):
-    # Initialize accelerator
-    if args.with_tracking:
-        accelerator = Accelerator(
-            cpu=args.cpu,
-            mixed_precision=args.mixed_precision,
-            log_with="jsonl",
-            project_dir=args.project_dir,
-        )
-    else:
-        accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
+def train_one_fold(config, args, fold: int):
+    # New Code: a fresh Accelerator per fold (singletons reset first)
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    accelerator = Accelerator(cpu=args.cpu, mixed_precision=args.mixed_precision)
     # Sample hyper-parameters for learning rate, batch size, seed and a few others
     lr = config["lr"]
     num_epochs = int(config["num_epochs"])
@@ -151,7 +145,8 @@ def training_function(config, args):
     if os.environ.get("TESTING_TINY_MODEL"):
         model_config = TransformerConfig.tiny(causal=False, dtype=compute_dtype(accelerator))
         num_epochs = int(os.environ.get("TESTING_NUM_EPOCHS", num_epochs))
-    train_dataloader, eval_dataloader = get_dataloaders(accelerator, batch_size, model_config)
+    train_dataloader, eval_dataloader, test_dataloader = get_fold_dataloaders(
+        accelerator, fold, int(args.num_folds), batch_size, model_config)
     model = SequenceClassifier(model_config, num_labels=2)
     variables = model.init(
         jax.random.PRNGKey(seed),
@@ -171,8 +166,9 @@ def training_function(config, args):
     # state is init'd congruent with them, loaders yield global batches.
     # There is no specific order to remember, we just need to unpack the
     # objects in the same order we gave them to the prepare method.
-    params, optimizer, train_dataloader, eval_dataloader = accelerator.prepare(
-        variables["params"], optimizer, train_dataloader, eval_dataloader
+    params, optimizer, train_dataloader, eval_dataloader, test_dataloader = accelerator.prepare(
+        variables["params"], optimizer, train_dataloader, eval_dataloader,
+        test_dataloader,
     )
 
     # The fused train step: forward+backward+clip+update, one XLA program
@@ -188,29 +184,10 @@ def training_function(config, args):
         )
         return jnp.argmax(logits, axis=-1)
 
-    # We need to initialize the trackers we use, and also store our configuration
-    if args.with_tracking:
-        run = os.path.split(__file__)[-1].split(".")[0]
-        accelerator.init_trackers(run, config)
-
-    # We need to keep track of how many total steps we have iterated over
-    overall_step = 0
-
     # Now we train the model
     for epoch in range(num_epochs):
-        if args.with_tracking:
-            total_loss = 0.0
         for step, batch in enumerate(train_dataloader):
             carry, metrics = train_step(carry, batch)
-            overall_step += 1
-            if args.with_tracking:
-                total_loss = total_loss + metrics["loss"]
-                if step % 50 == 0:
-                    # periodic host read of the running sum: exactness is
-                    # unchanged, async dispatch stays bounded (deep queues
-                    # of tiny programs can starve XLA:CPU rendezvous on
-                    # small test hosts), and TPU steps stay async between
-                    total_loss = float(total_loss)
             if step % 50 == 0:
                 # periodic host read: live progress, and it bounds the async
                 # dispatch queue (deep queues of collective programs can
@@ -231,19 +208,41 @@ def training_function(config, args):
             total += int(np.asarray(references).shape[0])
         eval_metric = {"accuracy": correct / max(total, 1)}
         # Use accelerator.print to print only on the main process.
-        accelerator.print(f"epoch {epoch}: train_loss {train_loss:.4f}", eval_metric)
-        if args.with_tracking:
-            accelerator.log(
-                {
-                    "accuracy": eval_metric["accuracy"],
-                    "train_loss": float(total_loss) / steps_per_epoch,
-                    "epoch": epoch,
-                },
-                step=overall_step,
-            )
-    if args.with_tracking:
-        accelerator.end_training()
-    return eval_metric
+        accelerator.print(f"fold {fold} epoch {epoch}: train_loss {train_loss:.4f}", eval_metric)
+
+    # New Code: this fold's LOGITS on the shared test slice + the labels
+    @jax.jit
+    def logits_step(params, batch):
+        return model.apply(
+            {"params": params}, batch["input_ids"], batch["attention_mask"]
+        ).astype(jnp.float32)
+
+    fold_logits, fold_labels = [], []
+    for batch in test_dataloader:
+        logits = logits_step(carry["params"], batch)
+        logits, references = accelerator.gather_for_metrics(
+            (logits, batch["labels"])
+        )
+        fold_logits.append(np.asarray(logits))
+        fold_labels.append(np.asarray(references))
+    return eval_metric, np.concatenate(fold_logits), np.concatenate(fold_labels)
+
+
+def training_function(config, args):
+    # New Code: run every fold, then ensemble by averaging test logits —
+    # the cross-validated estimate beats any single fold's
+    fold_metrics, all_logits, labels = [], [], None
+    for fold in range(int(args.num_folds)):
+        metric, logits, labels = train_one_fold(config, args, fold)
+        fold_metrics.append(metric["accuracy"])
+        all_logits.append(logits)
+    ensemble = np.mean(np.stack(all_logits), axis=0).argmax(-1)
+    ensemble_accuracy = float(np.mean(ensemble == labels))
+    print(
+        f"fold accuracies {['%.4f' % a for a in fold_metrics]} -> "
+        f"ensemble accuracy {ensemble_accuracy:.4f}"
+    )
+    return {"accuracy": ensemble_accuracy, "folds": fold_metrics}
 
 
 def compute_dtype(accelerator: Accelerator) -> str:
@@ -263,15 +262,8 @@ def main():
     )
     parser.add_argument("--cpu", action="store_true", help="If passed, will train on the CPU.")
     parser.add_argument(
-        "--with_tracking",
-        action="store_true",
-        help="Whether to load in all available experiment trackers from the environment and use them for logging.",
-    )
-    parser.add_argument(
-        "--project_dir",
-        type=str,
-        default="logs",
-        help="Location on where to store experiment tracking logs and relevent project information",
+        "--num_folds", type=int, default=3,
+        help="The number of cross-validation splits to train.",
     )
     args = parser.parse_args()
     config = {"lr": 2e-4, "num_epochs": 3, "seed": 42, "batch_size": 16}
