@@ -259,8 +259,18 @@ class OffloadedLeaf:
         return f"OffloadedLeaf({self.name!r}, {self.shape}, {self.dtype})"
 
 
+def _is_host_resident(leaf: Any) -> bool:
+    """True for a jax.Array parked in pinned_host memory (the TPU cpu
+    tier). XLA does NOT auto-insert transfers for mixed memory spaces —
+    computing with such a leaf raises 'memory_space of all inputs ...
+    must be the same' — so apply paths must device_put it first."""
+    sharding = getattr(leaf, "sharding", None)
+    return getattr(sharding, "memory_kind", None) == "pinned_host"
+
+
 def materialize_offloaded(tree: Any, device: Optional[jax.Device] = None) -> Any:
-    """Replace every :class:`OffloadedLeaf` with a live device array.
+    """Replace every :class:`OffloadedLeaf` — and every pinned_host (cpu
+    tier) leaf — with a live device array.
 
     Peak HBM is the full tree — use :func:`streamed_apply` for models whose
     offloaded portion exceeds HBM. Other leaves pass through untouched.
@@ -272,10 +282,26 @@ def materialize_offloaded(tree: Any, device: Optional[jax.Device] = None) -> Any
                 jax.device_put(arr, device) if device is not None
                 else jnp.asarray(arr)
             )
+        if _is_host_resident(leaf):
+            # pinned_host -> device memory. Must go through a sharding with
+            # an explicit memory_kind: device_put(x, Device) refuses to
+            # change the memory space ("Memory kind mismatch")
+            return jax.device_put(leaf, _device_memory_sharding(device))
         return leaf
 
     return jax.tree.map(
         _one, tree, is_leaf=lambda x: isinstance(x, OffloadedLeaf)
+    )
+
+
+def _device_memory_sharding(device: Optional[jax.Device] = None):
+    from jax.sharding import SingleDeviceSharding
+
+    # local_devices: jax.devices()[0] is host 0's device and would be
+    # non-addressable from other hosts in a multi-host job
+    return SingleDeviceSharding(
+        device if device is not None else jax.local_devices()[0],
+        memory_kind="device",
     )
 
 
@@ -322,6 +348,16 @@ def streamed_apply(
                 f"layer dim; got leading dims {num_layers} vs {leaf.shape[0]}"
             )
 
+    # cpu-tier (pinned_host) leaves: normalize to host numpy ONCE before
+    # the loop — slicing in the pinned_host memory space does not execute
+    # on TPU backends (FAILED_PRECONDITION), and numpy slices per group
+    # keep the streaming property (device_put moves only [lo:hi) bytes)
+    stacked_params = jax.tree.map(
+        lambda l: np.asarray(l) if _is_host_resident(l) else l,
+        stacked_params,
+        is_leaf=lambda x: isinstance(x, OffloadedLeaf),
+    )
+
     def _slice_group(leaf, lo, hi):
         if isinstance(leaf, OffloadedLeaf):
             piece = np.asarray(leaf.memmap()[lo:hi])  # reads only [lo:hi)
@@ -365,10 +401,12 @@ def dispatch_params(
     offload_dir: Optional[str] = None,
 ) -> Any:
     """Place each param-tree group per ``device_map``: a device index puts
-    the group on that chip; "cpu" pins it in host RAM (XLA streams it in on
-    use when the platform supports pinned_host, else keeps numpy); "disk"
-    writes a memmap and returns a lazy :class:`OffloadedLeaf` handle that
-    :func:`materialize_offloaded` / :func:`streamed_apply` can execute
+    the group on that chip; "cpu" pins it in host RAM (pinned_host memory
+    on TPU — DMA-able without a host copy — else numpy); "disk" writes a
+    memmap and returns a lazy :class:`OffloadedLeaf` handle. Compute
+    cannot consume pinned_host/disk leaves directly: run the tree through
+    :func:`materialize_offloaded` (everything live, peak HBM = full tree)
+    or :func:`streamed_apply` (one layer group at a time)
     (reference dispatch_model + OffloadedWeightsLoader)."""
     check_device_map(params, device_map)
     devices = jax.local_devices()

@@ -12,7 +12,7 @@ from .estimate import estimate_command_parser
 from .launch import launch_command_parser
 from .merge import merge_command_parser
 from .test import test_command_parser
-from .tpu import tpu_command_parser
+from .tpu import provision_command_parser, tpu_command_parser
 
 
 def main(argv=None) -> None:
@@ -29,6 +29,7 @@ def main(argv=None) -> None:
     merge_command_parser(subparsers)
     test_command_parser(subparsers)
     tpu_command_parser(subparsers)
+    provision_command_parser(subparsers)
 
     args = parser.parse_args(argv)
     if not hasattr(args, "func"):

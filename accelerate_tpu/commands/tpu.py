@@ -74,6 +74,88 @@ def tpu_command(args) -> None:
     print("Successfully run command on every pod worker")
 
 
+def build_queued_resource_command(args) -> list[str]:
+    """``gcloud compute tpus queued-resources create`` invocation — the
+    managed-cloud job-submission seat (reference submits to SageMaker,
+    commands/launch.py:886 / utils/launch.py:464; the TPU-native analog
+    is a queued resource that provisions capacity and runs the training
+    command when granted). Pure — testable without gcloud."""
+    cfg: Optional[ClusterConfig] = None
+    config_path = args.config_file or default_config_file()
+    if os.path.isfile(config_path):
+        cfg = ClusterConfig.load(config_path)
+    tpu_name = args.tpu_name or (cfg.tpu_name if cfg else None)
+    tpu_zone = args.tpu_zone or (cfg.tpu_zone if cfg else None)
+    if not tpu_name:
+        raise ValueError(
+            "no TPU name: pass --tpu_name or set tpu_name in the config"
+        )
+    if not args.accelerator_type:
+        raise ValueError("--accelerator_type is required (e.g. v5e-16)")
+    out = [
+        "gcloud", "compute", "tpus", "queued-resources", "create", tpu_name,
+        "--node-id", tpu_name,
+        "--accelerator-type", args.accelerator_type,
+        "--runtime-version", args.runtime_version,
+    ]
+    if tpu_zone:
+        out += ["--zone", tpu_zone]
+    if args.spot:
+        out += ["--spot"]
+    if args.valid_until_duration:
+        out += ["--valid-until-duration", args.valid_until_duration]
+    if args.startup_command:
+        # the queued resource runs this on every worker once granted —
+        # typically an `accelerate-tpu launch ...` line
+        out += ["--metadata", f"startup-script=#! /bin/bash\n{args.startup_command}"]
+    return out
+
+
+def provision_command(args) -> None:
+    cmd = build_queued_resource_command(args)
+    if args.debug:
+        print(f"Running {' '.join(cmd)}")
+        return
+    # cmd[5] is the resolved name (args.tpu_name may be None when it came
+    # from the config file)
+    print(f"Submitting queued resource {cmd[5]}...")
+    subprocess.run(cmd, check=True)
+    print(
+        "Queued resource submitted — capacity is granted asynchronously; "
+        "check `gcloud compute tpus queued-resources list`"
+    )
+
+
+def provision_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "provision",
+            help="Submit a TPU queued-resource request (managed-cloud "
+            "job submission; runs a startup command when granted)",
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu provision")
+    parser.add_argument("--config_file", default=None,
+                        help="Launch config with tpu_name/tpu_zone")
+    parser.add_argument("--tpu_name", default=None)
+    parser.add_argument("--tpu_zone", default=None)
+    parser.add_argument("--accelerator_type", default=None,
+                        help="e.g. v5e-16, v5p-8")
+    parser.add_argument("--runtime_version", default="tpu-ubuntu2204-base")
+    parser.add_argument("--spot", action="store_true",
+                        help="Request preemptible (spot) capacity")
+    parser.add_argument("--valid_until_duration", default=None,
+                        help="Auto-cancel the request after e.g. 6h")
+    parser.add_argument("--startup_command", default=None,
+                        help="Command each worker runs once granted "
+                        "(e.g. an accelerate-tpu launch line)")
+    parser.add_argument("--debug", action="store_true",
+                        help="Print the gcloud command instead of running it")
+    if subparsers is not None:
+        parser.set_defaults(func=provision_command)
+    return parser
+
+
 def tpu_command_parser(subparsers=None) -> argparse.ArgumentParser:
     if subparsers is not None:
         parser = subparsers.add_parser(

@@ -168,8 +168,11 @@ def training_function(config, args):
 
         # Instantiate the optimizer with a linear warmup-decay schedule
         steps_per_epoch = len(train_dataloader)
+        # the schedule counts OPTIMIZER updates (one per accumulation
+        # group), so both warmup and decay scale by the accumulation factor
         schedule = optax.warmup_cosine_decay_schedule(
-            init_value=0.0, peak_value=lr, warmup_steps=steps_per_epoch // 4,
+            init_value=0.0, peak_value=lr,
+            warmup_steps=max(steps_per_epoch // 4 // gradient_accumulation_steps, 1),
             decay_steps=steps_per_epoch * num_epochs // gradient_accumulation_steps,
         )
         optimizer = optax.adamw(schedule, weight_decay=0.01)
