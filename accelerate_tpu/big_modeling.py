@@ -365,6 +365,11 @@ def streamed_apply(
                 jax.device_put(piece, device)
                 if device is not None else jnp.asarray(piece)
             )
+        if isinstance(leaf, np.ndarray) and device is not None:
+            # host-side leaves (incl. normalized cpu tier) must follow the
+            # requested device like the disk pieces do — a bare numpy
+            # slice would let jit commit it to the default device
+            return jax.device_put(leaf[lo:hi], device)
         return leaf[lo:hi]
 
     for lo in range(0, num_layers, group_size):

@@ -35,9 +35,9 @@ def build_gcloud_ssh_command(
     return out
 
 
-def build_pod_command(args) -> list[str]:
-    """Assemble the gcloud fan-out command line (pure — testable without
-    gcloud)."""
+def _resolve_tpu(args) -> tuple[str, Optional[str]]:
+    """(tpu_name, tpu_zone) from CLI args with config-file fallback —
+    shared by `tpu-config` and `provision` so resolution cannot drift."""
     cfg: Optional[ClusterConfig] = None
     config_path = args.config_file or default_config_file()
     if os.path.isfile(config_path):
@@ -49,6 +49,13 @@ def build_pod_command(args) -> list[str]:
             "no TPU name: pass --tpu_name or set tpu_name in the config "
             "(accelerate-tpu config)"
         )
+    return tpu_name, tpu_zone
+
+
+def build_pod_command(args) -> list[str]:
+    """Assemble the gcloud fan-out command line (pure — testable without
+    gcloud)."""
+    tpu_name, tpu_zone = _resolve_tpu(args)
 
     commands = list(_DEFAULT_CMD)
     if args.install_accelerate:
@@ -80,16 +87,7 @@ def build_queued_resource_command(args) -> list[str]:
     commands/launch.py:886 / utils/launch.py:464; the TPU-native analog
     is a queued resource that provisions capacity and runs the training
     command when granted). Pure — testable without gcloud."""
-    cfg: Optional[ClusterConfig] = None
-    config_path = args.config_file or default_config_file()
-    if os.path.isfile(config_path):
-        cfg = ClusterConfig.load(config_path)
-    tpu_name = args.tpu_name or (cfg.tpu_name if cfg else None)
-    tpu_zone = args.tpu_zone or (cfg.tpu_zone if cfg else None)
-    if not tpu_name:
-        raise ValueError(
-            "no TPU name: pass --tpu_name or set tpu_name in the config"
-        )
+    tpu_name, tpu_zone = _resolve_tpu(args)
     if not args.accelerator_type:
         raise ValueError("--accelerator_type is required (e.g. v5e-16)")
     out = [

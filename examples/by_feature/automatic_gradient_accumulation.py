@@ -173,7 +173,10 @@ def training_function(config, args):
         schedule = optax.warmup_cosine_decay_schedule(
             init_value=0.0, peak_value=lr,
             warmup_steps=max(steps_per_epoch // 4 // gradient_accumulation_steps, 1),
-            decay_steps=steps_per_epoch * num_epochs // gradient_accumulation_steps,
+            decay_steps=max(
+                steps_per_epoch * num_epochs // gradient_accumulation_steps,
+                steps_per_epoch // 4 // gradient_accumulation_steps + 2,
+            ),
         )
         optimizer = optax.adamw(schedule, weight_decay=0.01)
 
