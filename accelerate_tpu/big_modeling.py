@@ -365,10 +365,11 @@ def streamed_apply(
                 jax.device_put(piece, device)
                 if device is not None else jnp.asarray(piece)
             )
-        if isinstance(leaf, np.ndarray) and device is not None:
-            # host-side leaves (incl. normalized cpu tier) must follow the
-            # requested device like the disk pieces do — a bare numpy
-            # slice would let jit commit it to the default device
+        if device is not None:
+            # EVERY group must follow the requested device like the disk
+            # pieces do — numpy slices would get committed to the default
+            # device and device-committed jax.Arrays would stay put,
+            # either way handing jit mixed-device inputs
             return jax.device_put(leaf[lo:hi], device)
         return leaf[lo:hi]
 

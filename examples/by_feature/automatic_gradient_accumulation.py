@@ -170,12 +170,14 @@ def training_function(config, args):
         steps_per_epoch = len(train_dataloader)
         # the schedule counts OPTIMIZER updates (one per accumulation
         # group), so both warmup and decay scale by the accumulation factor
+        warmup_steps = max(steps_per_epoch // 4 // gradient_accumulation_steps, 1)
         schedule = optax.warmup_cosine_decay_schedule(
             init_value=0.0, peak_value=lr,
-            warmup_steps=max(steps_per_epoch // 4 // gradient_accumulation_steps, 1),
+            warmup_steps=warmup_steps,
+            # optax requires decay_steps > warmup_steps
             decay_steps=max(
                 steps_per_epoch * num_epochs // gradient_accumulation_steps,
-                steps_per_epoch // 4 // gradient_accumulation_steps + 2,
+                warmup_steps + 1,
             ),
         )
         optimizer = optax.adamw(schedule, weight_decay=0.01)
