@@ -552,6 +552,101 @@ class Accelerator:
         step_fn.jitted = jitted  # escape hatch: no host-mirror bookkeeping
         return step_fn
 
+    def unified_pipeline_step(
+        self,
+        block_fn: Callable[[Any, Any], Any],
+        loss_fn: Callable[[Any, Any], Any],
+        optimizer: Optional[AcceleratedOptimizer] = None,
+        max_grad_norm: Optional[float] = None,
+        donate: bool = True,
+    ) -> Callable:
+        """THE train step for pipeline-parallel models: the 1F1B schedule
+        (``parallel.pipeline.pipeline_train_step`` — interleaved fwd/bwd,
+        ring-bounded in-flight state) plus clipping and the optimizer
+        update, one jitted XLA program.
+
+        ``block_fn(stage_params, x_mb) -> y_mb`` is the per-stage layer
+        stack; ``loss_fn(y_mb, target_mb) -> scalar`` must decompose over
+        microbatches (any per-sample mean/sum loss). Microbatch count
+        comes from ``ParallelismPlugin.num_micro_batches`` — pipeline
+        microbatching IS the accumulation, so build the Accelerator with
+        ``gradient_accumulation_steps=1``.
+
+        Returns ``step_fn(carry, x, targets) -> (carry, metrics)`` with
+        ``carry = accelerator.init_carry(stacked_params, optimizer)``.
+        The reference reaches this capability only through Megatron's
+        pipelined train_step (utils/megatron_lm.py:1037-1058).
+        """
+        import optax
+
+        from .parallel.pipeline import pipeline_train_step
+
+        optimizer = optimizer or (self._optimizers[0] if self._optimizers else None)
+        if optimizer is None:
+            raise ValueError("prepare() an optimizer before building the step")
+        if self.gradient_state.num_steps > 1:
+            raise ValueError(
+                "unified_pipeline_step microbatches via num_micro_batches; "
+                "use gradient_accumulation_steps=1"
+            )
+        policy = self.state.mixed_precision_policy
+        if policy.uses_loss_scaling:
+            # fp16 would need the loss scale threaded through the 1F1B
+            # schedule (scaled cotangents + finite-skip) — unimplemented;
+            # refuse rather than silently committing overflowed grads
+            raise NotImplementedError(
+                "unified_pipeline_step does not support fp16 loss scaling; "
+                "use mixed_precision='bf16' (TPU-native) or 'no'"
+            )
+        mesh = self.mesh
+        num_micro = self.state.parallelism_plugin.num_micro_batches
+        opt_transform = optimizer.optimizer
+
+        def _step(carry, x, targets):
+            params, opt_state = carry["params"], carry["opt_state"]
+            compute_params = _cast_floating(params, policy.compute_dtype)
+            compute_x = _cast_floating(x, policy.compute_dtype)
+            loss, grads = pipeline_train_step(
+                block_fn, loss_fn, compute_params, compute_x, targets,
+                mesh=mesh, num_micro_batches=num_micro,
+            )
+            grads = _cast_floating(grads, jnp.float32)
+            gnorm = optax.global_norm(grads)
+            if max_grad_norm is not None:
+                scale_c = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * scale_c, grads)
+            updates, new_opt_state = opt_transform.update(
+                grads, opt_state, params
+            )
+            new_params = optax.apply_updates(params, updates)
+            new_carry = {
+                **carry,
+                "params": new_params,
+                "opt_state": new_opt_state,
+                "opt_step": carry["opt_step"] + 1,
+            }
+            metrics = {
+                "loss": loss.astype(jnp.float32),
+                "grad_norm": gnorm,
+                # parity with unified_step's metric surface
+                "grads_finite": jnp.isfinite(gnorm),
+                "is_sync_step": jnp.asarray(True),
+            }
+            return new_carry, metrics
+
+        donate_args = (0,) if (donate and self.compile_plugin.donate_state) else ()
+        jitted = jax.jit(_step, donate_argnums=donate_args)
+
+        def step_fn(carry, x, targets):
+            out = jitted(carry, x, targets)
+            # host mirror: every pipeline step is an optimizer step
+            self.step += 1
+            self.gradient_state.sync_gradients = True
+            return out
+
+        step_fn.jitted = jitted  # escape hatch, same as unified_step
+        return step_fn
+
     def init_carry(
         self, params: Any, optimizer: Optional[AcceleratedOptimizer] = None
     ) -> dict:
