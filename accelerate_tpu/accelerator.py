@@ -602,12 +602,44 @@ class Accelerator:
         num_micro = self.state.parallelism_plugin.num_micro_batches
         opt_transform = optimizer.optimizer
 
+        def _named_shardings(tree):
+            # same rationale as unified_step: pin outputs to the plan so
+            # GSPMD propagation can't reshard params to follow the opt
+            # state after the first update; only NamedSharding leaves pin.
+            # Reads LIVE arrays (captured at trace time), never tracers.
+            return jax.tree.map(
+                lambda v: v.sharding
+                if isinstance(v, jax.Array) and isinstance(v.sharding, NamedSharding)
+                else None,
+                tree,
+            )
+
+        def _opt_shardings():
+            # resolved lazily at trace time — init_carry has run by then
+            return (
+                _named_shardings(optimizer.opt_state)
+                if optimizer.opt_state is not None
+                else None
+            )
+
+        def _pin_tree(tree, shardings):
+            if shardings is None:
+                return tree
+            return jax.tree.map(
+                lambda v, s: v
+                if s is None
+                else jax.lax.with_sharding_constraint(v, s),
+                tree,
+                shardings,
+            )
+
         def _step(carry, x, targets):
             params, opt_state = carry["params"], carry["opt_state"]
             compute_params = _cast_floating(params, policy.compute_dtype)
             compute_x = _cast_floating(x, policy.compute_dtype)
+            compute_targets = _cast_floating(targets, policy.compute_dtype)
             loss, grads = pipeline_train_step(
-                block_fn, loss_fn, compute_params, compute_x, targets,
+                block_fn, loss_fn, compute_params, compute_x, compute_targets,
                 mesh=mesh, num_micro_batches=num_micro,
             )
             grads = _cast_floating(grads, jnp.float32)
@@ -619,6 +651,8 @@ class Accelerator:
                 grads, opt_state, params
             )
             new_params = optax.apply_updates(params, updates)
+            new_params = _pin_tree(new_params, self._param_shardings)
+            new_opt_state = _pin_tree(new_opt_state, _opt_shardings())
             new_carry = {
                 **carry,
                 "params": new_params,

@@ -33,9 +33,12 @@ class TransformerConfig:
     # MoE (Mixtral family); 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
-    # "capacity": sparse GShard-style dispatch (ops/moe.py) — FLOPs scale
-    # with K*capacity_factor, not E; "dense": every expert sees every token
-    # (the exact-math test oracle)
+    # "ragged": grouped-matmul dispatch (jax.lax.ragged_dot) — exact math
+    # (no capacity padding, no token drops) at capacity-schedule speed;
+    # single-chip/dp only. "capacity": GShard-style static-shape dispatch
+    # — the expert-parallel (ep_size>1) path, FLOPs scale with
+    # K*capacity_factor, overflow tokens drop. "dense": every expert sees
+    # every token (the exact-math test oracle, O(E) FLOPs)
     moe_dispatch: str = "capacity"
     moe_capacity_factor: float = 2.0
     # fp8 projections: e4m3 fwd / e5m2 bwd matmuls (ops/fp8.py) — the

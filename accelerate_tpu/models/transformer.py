@@ -247,7 +247,29 @@ class MoE(nn.Module):
         w_down = epar("down_proj", (E, f, h), ("expert", "mlp", "embed"))
 
         xc = x.astype(dtype)
-        if cfg.moe_dispatch == "capacity":
+        if cfg.moe_dispatch == "ragged":
+            from ..ops.moe import moe_ragged
+            from ..parallel.sharding import live_mesh
+
+            mesh = live_mesh()
+            if mesh is not None and mesh.shape.get("ep", 1) > 1:
+                # data-dependent group sizes cannot shard over ep: GSPMD
+                # would all-gather the full expert weights everywhere
+                raise ValueError(
+                    "moe_dispatch='ragged' does not compose with ep_size>1;"
+                    " use 'capacity' (static all-to-all) for expert "
+                    "parallelism"
+                )
+
+            out = moe_ragged(
+                xc.reshape(b * s, h),
+                sel.reshape(b * s, K),
+                weights.reshape(b * s, K),
+                w_gate.astype(dtype),
+                w_up.astype(dtype),
+                w_down.astype(dtype),
+            ).reshape(b, s, h)
+        elif cfg.moe_dispatch == "capacity":
             def experts_fn(buf):  # (E, C, h) -> (E, C, h)
                 hidden = jnp.einsum("ech,ehf->ecf", buf, w_gate.astype(dtype))
                 hidden = nn.silu(hidden) * jnp.einsum(
@@ -278,7 +300,8 @@ class MoE(nn.Module):
             out = jnp.einsum("ebsh,bse->bsh", expert_out, combine.astype(dtype))
         else:
             raise ValueError(
-                f"unknown moe_dispatch {cfg.moe_dispatch!r}; use 'capacity' or 'dense'"
+                f"unknown moe_dispatch {cfg.moe_dispatch!r}; use 'ragged', "
+                "'capacity' or 'dense'"
             )
         self.sow(
             "intermediates", "moe_aux_loss", load_balancing_loss(logits, sel, E)

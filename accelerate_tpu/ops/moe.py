@@ -54,6 +54,59 @@ def no_drop_capacity_factor(num_experts: int, num_selected: int) -> float:
     return num_experts / num_selected
 
 
+def moe_ragged(
+    x: jax.Array,
+    sel: jax.Array,
+    weights: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+) -> jax.Array:
+    """Exact sparse MoE via grouped matmuls (``jax.lax.ragged_dot``).
+
+    Tokens sort by their selected expert; each expert's contiguous group
+    multiplies against its weights with NO capacity padding and NO drops —
+    exactly ``T*K`` token-expert pairs of FLOPs (the capacity schedule
+    computes ``capacity_factor`` times that and drops overflow).
+
+    Measured at the bench MoE shapes on v5e (bf16): fwd+bwd ~11% faster
+    than capacity-1.25 WITHOUT remat (23.7 vs 26.6 ms/layer), roughly
+    equal under remat="dots" (XLA's ragged_dot is not a plain dot, so the
+    dots policy recomputes it in backward). Pick it for EXACTNESS — the
+    math equals the dense oracle (every selected pair computed, weighted,
+    summed; no silently dropped tokens), at capacity-schedule speed.
+
+    Fully differentiable (ragged_dot has grad rules; sort / gather /
+    scatter-add are linear).
+
+    Use on single-chip / data-parallel meshes. With ``ep_size > 1`` the
+    per-expert group sizes are data-dependent, which GSPMD cannot shard
+    over the ep axis — the capacity schedule (static all-to-all shapes)
+    remains the expert-parallel path.
+
+    ``x``: (T, h); ``sel``/``weights``: (T, K); ``w_gate``/``w_up``:
+    (E, h, f); ``w_down``: (E, f, h). Returns (T, h).
+    """
+    T, h = x.shape
+    K = sel.shape[-1]
+    E = w_gate.shape[0]
+    flat_sel = sel.reshape(T * K)
+    order = jnp.argsort(flat_sel)  # jnp.argsort is stable: ties keep token order
+    tok = jnp.repeat(jnp.arange(T), K)[order]  # source token per sorted row
+    xs = jnp.take(x, tok, axis=0)  # (TK, h) rows grouped by expert
+    group_sizes = jnp.bincount(flat_sel, length=E).astype(jnp.int32)
+
+    hidden = jax.nn.silu(
+        jax.lax.ragged_dot(xs, w_gate, group_sizes)
+    ) * jax.lax.ragged_dot(xs, w_up, group_sizes)  # (TK, f)
+    out = jax.lax.ragged_dot(hidden, w_down, group_sizes)  # (TK, h)
+
+    w_flat = weights.reshape(T * K)[order].astype(out.dtype)
+    # combine: weighted scatter-add back into token order (sums the K
+    # expert contributions per token)
+    return jnp.zeros((T, h), out.dtype).at[tok].add(out * w_flat[:, None])
+
+
 def moe_dispatch_combine(
     x: jax.Array,
     sel: jax.Array,
