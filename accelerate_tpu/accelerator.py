@@ -394,17 +394,6 @@ class Accelerator:
         # operands (e.g. ZeRO-1's sharded moments would drag the replicated
         # params into fsdp shards after one step).
 
-        def _named_only(tree):
-            # scalar counters etc. carry SingleDeviceSharding — constraining
-            # to one device inside a multi-device jit is an error; pin only
-            # mesh-aware NamedSharding leaves and let XLA place the rest
-            return jax.tree.map(
-                lambda x: x.sharding
-                if isinstance(x, jax.Array) and isinstance(x.sharding, NamedSharding)
-                else None,
-                tree,
-            )
-
         def _opt_shardings():
             # Resolved lazily INSIDE _step (i.e. at trace time, on the first
             # step call): the step can only run with a carry from
@@ -412,21 +401,12 @@ class Accelerator:
             # then — capturing at build time would silently disable ZeRO-1/2
             # pinning when unified_step is built before init_carry.
             return (
-                _named_only(optimizer.opt_state)
+                _named_sharding_tree(optimizer.opt_state)
                 if optimizer.opt_state is not None
                 else None
             )
 
-        def _pin(tree, shardings):
-            if shardings is None:
-                return tree
-            return jax.tree.map(
-                lambda x, s: x
-                if s is None
-                else jax.lax.with_sharding_constraint(x, s),
-                tree,
-                shardings,
-            )
+        _pin = _pin_to_shardings
 
         def _step(carry: dict, batch: Any, **kw):
             params = carry["params"]
@@ -602,35 +582,12 @@ class Accelerator:
         num_micro = self.state.parallelism_plugin.num_micro_batches
         opt_transform = optimizer.optimizer
 
-        def _named_shardings(tree):
-            # same rationale as unified_step: pin outputs to the plan so
-            # GSPMD propagation can't reshard params to follow the opt
-            # state after the first update; only NamedSharding leaves pin.
-            # Reads LIVE arrays (captured at trace time), never tracers.
-            return jax.tree.map(
-                lambda v: v.sharding
-                if isinstance(v, jax.Array) and isinstance(v.sharding, NamedSharding)
-                else None,
-                tree,
-            )
-
         def _opt_shardings():
             # resolved lazily at trace time — init_carry has run by then
             return (
-                _named_shardings(optimizer.opt_state)
+                _named_sharding_tree(optimizer.opt_state)
                 if optimizer.opt_state is not None
                 else None
-            )
-
-        def _pin_tree(tree, shardings):
-            if shardings is None:
-                return tree
-            return jax.tree.map(
-                lambda v, s: v
-                if s is None
-                else jax.lax.with_sharding_constraint(v, s),
-                tree,
-                shardings,
             )
 
         def _step(carry, x, targets):
@@ -651,8 +608,8 @@ class Accelerator:
                 grads, opt_state, params
             )
             new_params = optax.apply_updates(params, updates)
-            new_params = _pin_tree(new_params, self._param_shardings)
-            new_opt_state = _pin_tree(new_opt_state, _opt_shardings())
+            new_params = _pin_to_shardings(new_params, self._param_shardings)
+            new_opt_state = _pin_to_shardings(new_opt_state, _opt_shardings())
             new_carry = {
                 **carry,
                 "params": new_params,
@@ -1115,3 +1072,30 @@ def _cast_floating(tree: Any, dtype) -> Any:
         return x
 
     return jax.tree.map(_cast, tree)
+
+
+def _named_sharding_tree(tree: Any) -> Any:
+    """Shardings of LIVE arrays (never tracers), NamedSharding leaves only:
+    scalar counters etc. carry SingleDeviceSharding — constraining to one
+    device inside a multi-device jit is an error, so those pin as None and
+    XLA places them. Shared by unified_step and unified_pipeline_step."""
+    return jax.tree.map(
+        lambda x: x.sharding
+        if isinstance(x, jax.Array) and isinstance(x.sharding, NamedSharding)
+        else None,
+        tree,
+    )
+
+
+def _pin_to_shardings(tree: Any, shardings: Any) -> Any:
+    """with_sharding_constraint every leaf with a non-None sharding — the
+    guard that stops GSPMD propagation from resharding step outputs to
+    follow other operands (e.g. ZeRO-1's sharded moments dragging the
+    replicated params into fsdp shards after one update)."""
+    if shardings is None:
+        return tree
+    return jax.tree.map(
+        lambda x, s: x if s is None else jax.lax.with_sharding_constraint(x, s),
+        tree,
+        shardings,
+    )

@@ -204,10 +204,12 @@ class MoE(nn.Module):
     dispatch/combine lowers to all-to-all — the expert-parallel capability
     absent from the reference (SURVEY.md §2.4 EP row).
 
-    Two dispatch modes (``config.moe_dispatch``): "capacity" — the
-    production GShard-style sparse schedule (ops/moe.py, FLOPs independent
-    of E); "dense" — every expert computes every token (O(E) FLOPs, exact
-    math, the test oracle).
+    Three dispatch modes (``config.moe_dispatch``): "ragged" — grouped
+    matmuls via jax.lax.ragged_dot, exact math with no capacity padding
+    or drops (single-chip/dp); "capacity" — the GShard-style static-shape
+    schedule (ops/moe.py, FLOPs independent of E, the ep_size>1 path);
+    "dense" — every expert computes every token (O(E) FLOPs, exact math,
+    the test oracle).
     """
 
     config: TransformerConfig
