@@ -406,8 +406,6 @@ class Accelerator:
                 else None
             )
 
-        _pin = _pin_to_shardings
-
         def _step(carry: dict, batch: Any, **kw):
             params = carry["params"]
             opt_state = carry["opt_state"]
@@ -462,8 +460,8 @@ class Accelerator:
                 new_params = optax.apply_updates(params, updates)
                 # self._param_shardings read at trace time for the same
                 # build-order reason as _opt_shardings
-                new_params = _pin(new_params, self._param_shardings)
-                new_opt_state = _pin(new_opt_state, _opt_shardings())
+                new_params = _pin_to_shardings(new_params, self._param_shardings)
+                new_opt_state = _pin_to_shardings(new_opt_state, _opt_shardings())
                 # fp16 overflow: keep old params/state (GradScaler skip)
                 new_params = jax.tree.map(
                     lambda n, o: jnp.where(finite, n, o), new_params, params

@@ -323,24 +323,61 @@ def main():
     # (the driver parses the final line).
     import subprocess
 
+    import time as _time
+
+    def _implausible(rec: dict) -> bool:
+        # the tunneled chip occasionally degrades ~20x right after long
+        # multi-process sessions (observed: dense at 1.2k tok/s vs the
+        # usual 26k, recovering by itself a minute later) — a train
+        # variant reporting under 10% MFU on real hardware is that
+        # transient, not a real measurement
+        return (
+            rec["unit"] == "tokens/s/chip"
+            and rec["extra"].get("mfu", 1.0) < 0.10
+        )
+
     results: dict[str, dict] = {}
     errors: dict[str, str] = {}
     for name in configs:
-        try:
-            proc = subprocess.run(
-                [sys.executable, __file__, name], text=True,
-                capture_output=True, timeout=900,
+        rec = None
+        proc = None
+        retried = False
+        for attempt in range(2):
+            try:
+                proc = subprocess.run(
+                    [sys.executable, __file__, name], text=True,
+                    capture_output=True, timeout=900,
+                )
+            except subprocess.TimeoutExpired:
+                # discard any implausible first-attempt record too — never
+                # publish a known-bad measurement alongside an error
+                rec = None
+                errors[name] = "timeout after 900s"
+                break
+            line = next(
+                (l for l in proc.stdout.splitlines() if l.startswith("{")), None
             )
-        except subprocess.TimeoutExpired:
-            errors[name] = "timeout after 900s"
-            continue
-        line = next(
-            (l for l in proc.stdout.splitlines() if l.startswith("{")), None
-        )
-        if proc.returncode == 0 and line:
-            results[name] = json.loads(line)
-        else:
-            err = proc.stderr or "no output"
+            if proc.returncode != 0 or line is None:
+                rec = None
+                break
+            rec = json.loads(line)
+            if _implausible(rec) and attempt == 0:
+                print(
+                    f"variant {name} implausibly slow "
+                    f"({rec['value']} {rec['unit']}); retrying after "
+                    "a 60s settle",
+                    file=sys.stderr,
+                )
+                retried = True
+                _time.sleep(60)
+                continue
+            break
+        if rec is not None:
+            if retried:  # mark the KEPT record, not the discarded one
+                rec["extra"]["retried"] = True
+            results[name] = rec
+        elif name not in errors:
+            err = (proc.stderr if proc else None) or "no output"
             oom = next(
                 (l.strip() for l in err.splitlines()
                  if "RESOURCE_EXHAUSTED" in l or "Ran out of memory" in l),
