@@ -351,7 +351,11 @@ def streamed_apply(
     # cpu-tier (pinned_host) leaves: normalize to host numpy ONCE before
     # the loop — slicing in the pinned_host memory space does not execute
     # on TPU backends (FAILED_PRECONDITION), and numpy slices per group
-    # keep the streaming property (device_put moves only [lo:hi) bytes)
+    # keep the streaming property (device_put moves only [lo:hi) bytes).
+    # Cost: while this call runs, cpu-tier leaves exist TWICE on host
+    # (the caller's pinned buffer + this numpy copy) — ~2x host RAM for
+    # that tier. Partial host reads of pinned_host arrays are not
+    # expressible today; revisit if jax grows a host-slice primitive.
     stacked_params = jax.tree.map(
         lambda l: np.asarray(l) if _is_host_resident(l) else l,
         stacked_params,

@@ -12,6 +12,48 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 
+SUPPORTED_ROPE_TYPES = ("default", "llama3", "linear")
+# required rope_scaling keys per type (beyond rope_type itself)
+_ROPE_REQUIRED_KEYS = {
+    "default": (),
+    "linear": ("factor",),
+    "llama3": (
+        "factor",
+        "low_freq_factor",
+        "high_freq_factor",
+        "original_max_position_embeddings",
+    ),
+}
+
+
+def rope_type(scaling: Optional[dict]) -> str:
+    """The rope_type of an HF-style ``rope_scaling`` dict (accepting the
+    legacy ``type`` key), ``"default"`` when absent — the ONE place this
+    extraction lives (used by config validation, hf interop, and the rope
+    implementation)."""
+    if not scaling:
+        return "default"
+    return scaling.get("rope_type", scaling.get("type", "default"))
+
+
+def validate_rope_scaling(scaling: Optional[dict]) -> None:
+    """Reject unsupported types AND missing parameters up front: a
+    scaling dict that only fails at trace time (KeyError inside jit)
+    would defeat the loader's fail-loudly contract."""
+    rt = rope_type(scaling)
+    if rt not in SUPPORTED_ROPE_TYPES:
+        raise ValueError(
+            f"unsupported rope_scaling type {rt!r}; "
+            f"supported: {', '.join(SUPPORTED_ROPE_TYPES)}"
+        )
+    missing = [k for k in _ROPE_REQUIRED_KEYS[rt] if k not in (scaling or {})]
+    if missing:
+        raise ValueError(
+            f"rope_scaling type {rt!r} requires keys {missing} "
+            f"(got {sorted(scaling)})"
+        )
+
+
 @dataclass
 class TransformerConfig:
     vocab_size: int = 32000
@@ -25,6 +67,13 @@ class TransformerConfig:
     head_dim: Optional[int] = None  # None -> hidden_size // num_heads
     max_seq_len: int = 2048
     rope_theta: float = 500000.0
+    # HF-style rope frequency scaling (Llama-3.1+ ships
+    # ``{"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+    # "high_freq_factor": 4.0, "original_max_position_embeddings": 8192}``);
+    # supported rope_types: "llama3", "linear", "default"/None. Applied in
+    # models/transformer.rope — keep in sync with transformers'
+    # _compute_llama3_parameters so HF checkpoints logits-match.
+    rope_scaling: Optional[dict] = None
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
     # False -> bidirectional self-attention (BERT-family encoders)
@@ -52,6 +101,10 @@ class TransformerConfig:
     dtype: str = "float32"  # activation dtype at apply time
 
     def __post_init__(self):
+        # an unsupported/underspecified rope_scaling silently ignored (or
+        # crashing only at trace time) would pass every weight check and
+        # still diverge from the source model
+        validate_rope_scaling(self.rope_scaling)
         if self.num_kv_heads is None:
             self.num_kv_heads = self.num_heads
         if self.head_dim is None:

@@ -61,10 +61,49 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(x.dtype)
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def _scale_rope_freqs(freqs: jax.Array, scaling: Optional[dict]) -> jax.Array:
+    """Apply HF-style rope frequency scaling to inverse frequencies.
+
+    ``llama3`` mirrors transformers' ``_compute_llama3_parameters``
+    (modeling_rope_utils.py): frequencies whose wavelength exceeds the
+    original context keep full resolution divided by ``factor``, short
+    wavelengths are untouched, and a smooth ramp interpolates between the
+    two bands. ``linear`` is plain position-interpolation (freq/factor).
+    The parity anchor is reference utils/modeling.py:1608 — its loader is
+    architecture-faithful to whatever rope the checkpoint declares.
+    """
+    from .config import rope_type as _rope_type
+
+    rt = _rope_type(scaling)
+    if rt == "default":
+        return freqs
+    factor = float(scaling["factor"])
+    if rt == "linear":
+        return freqs / factor
+    if rt == "llama3":
+        low = float(scaling["low_freq_factor"])
+        high = float(scaling["high_freq_factor"])
+        old_len = float(scaling["original_max_position_embeddings"])
+        wavelen = 2.0 * jnp.pi / freqs
+        # smooth ramp between the low/high frequency bands
+        smooth = (old_len / wavelen - low) / (high - low)
+        smoothed = (1.0 - smooth) * freqs / factor + smooth * freqs
+        scaled = jnp.where(wavelen > old_len / low, freqs / factor, freqs)
+        is_medium = (wavelen <= old_len / low) & (wavelen >= old_len / high)
+        return jnp.where(is_medium, smoothed, scaled)
+    raise ValueError(f"unsupported rope_scaling type {rt!r}")
+
+
+def rope(
+    x: jax.Array,
+    positions: jax.Array,
+    theta: float,
+    scaling: Optional[dict] = None,
+) -> jax.Array:
     """Rotary position embedding, x: (B, S, H, D), positions: (B, S)."""
     d = x.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = _scale_rope_freqs(freqs, scaling)
     angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # (B,S,1,D/2)
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
@@ -143,8 +182,8 @@ class Attention(nn.Module):
         if decode:
             idx = cache_index.value
             positions = idx + jnp.arange(s)[None, :]  # (1, s) broadcasts over batch
-            q = rope(q, positions, cfg.rope_theta)
-            k = rope(k, positions, cfg.rope_theta)
+            q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+            k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
             key_cache = jax.lax.dynamic_update_slice(
                 cached_key.value, k, (0, idx, 0, 0)
             )
@@ -164,8 +203,8 @@ class Attention(nn.Module):
                 implementation="xla",
             )
         else:
-            q = rope(q, positions, cfg.rope_theta)
-            k = rope(k, positions, cfg.rope_theta)
+            q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+            k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
             out = dot_product_attention(
                 q, k, v, mask=mask, causal=cfg.causal,
                 kv_lengths=kv_lengths,
@@ -354,6 +393,17 @@ def _make_embed(cfg: TransformerConfig, dtype, name: Optional[str] = "embed") ->
 _REMAT_POLICIES = {
     "full": lambda: None,
     "dots": lambda: jax.checkpoint_policies.checkpoint_dots,
+    # "dots" + grouped-matmul outputs: checkpoint_dots matches only the
+    # dot_general primitive, so under moe_dispatch="ragged" the backward
+    # would re-run every ragged_dot (the expert FLOPs — the single biggest
+    # matmul cost in an MoE block). Saving ragged_dot_general too keeps
+    # the remat recompute down to elementwise ops, same as "dots" does
+    # for dense blocks.
+    "dots_ragged": lambda: jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.checkpoint_dots,
+        lambda prim, *_, **__: getattr(prim, "name", "")
+        == "ragged_dot_general",
+    ),
     "dots_with_no_batch_dims": (
         lambda: jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
     ),

@@ -27,9 +27,10 @@ Rope compatibility: both sides use the GPT-NeoX-style half-split
 rotation (HF ``rotate_half`` == models/transformer.rope), so weights
 interchange without any permutation of head dims.
 
-Architectures covered: the Llama family (Llama-2/3 incl. GQA, tied or
-untied heads) and Mixtral-style MoE — the BASELINE.md targets
-(Llama-3-8B FSDP, Mixtral 8x7B EP, Llama-3-70B device_map="auto").
+Architectures covered: the Llama family (Llama-2/3/3.1+ incl. GQA,
+llama3/linear rope scaling, tied or untied heads) and Mixtral-style MoE
+— the BASELINE.md targets (Llama-3-8B FSDP, Mixtral 8x7B EP,
+Llama-3-70B device_map="auto").
 BERT/GPT-2/T5 checkpoints do NOT map: this package's encoder/seq2seq are
 modernized architectures (RMSNorm + rope + SwiGLU, no biases) with no
 faithful parameter correspondence; they train from scratch or load
@@ -130,16 +131,11 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
     with open(cfg_path) as f:
         hf = json.load(f)
     model_type = hf.get("model_type", "llama")
+    # rope_scaling (llama3 / linear applied natively; yarn etc. rejected)
+    # is validated by TransformerConfig.__post_init__ — the construction
+    # below fails loudly, including on parameter keys missing for the
+    # declared type, so nothing can only blow up at trace time.
     rope_scaling = hf.get("rope_scaling")
-    if rope_scaling and rope_scaling.get("rope_type", rope_scaling.get("type")) != "default":
-        # Llama-3.1+ scales rope frequencies (rope_type "llama3"); the
-        # native rope() uses plain theta — loading would pass every
-        # tensor check yet silently diverge from transformers logits.
-        raise ValueError(
-            f"HF config.json declares rope_scaling={rope_scaling!r}, which "
-            "the native rope implementation does not apply; only "
-            "plain-theta rope checkpoints (Llama-2/3.0 style) load"
-        )
     if model_type not in ("llama", "mixtral"):
         # Qwen2/Gemma/... share the model.layers.* key convention and every
         # config field this mapping reads, but differ in parameters the
@@ -158,6 +154,7 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
         num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
         max_seq_len=hf.get("max_position_embeddings", 2048),
         rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
     )
@@ -316,16 +313,29 @@ def hf_native_reader(
         plan = _plan_for(parts, config)
         if plan.stack == 0:
             return np.ascontiguousarray(maybe_t(read_hf(plan.keys[0]), plan.transpose))
+        # preallocate the assembled leaf and fill slice-by-slice, so peak
+        # host memory really is ONE assembled leaf + one HF tensor (a
+        # build-list-then-np.stack would transiently hold ~2x the leaf)
         if plan.stack == 1:
-            slices = [maybe_t(read_hf(k), plan.transpose) for k in plan.keys]
+            first = maybe_t(read_hf(plan.keys[0]), plan.transpose)
+            out = np.empty((len(plan.keys),) + first.shape, first.dtype)
+            out[0] = first
+            del first
+            for i, k in enumerate(plan.keys[1:], start=1):
+                out[i] = maybe_t(read_hf(k), plan.transpose)
         else:  # layers x experts
-            slices = [
-                np.stack([maybe_t(read_hf(k), plan.transpose) for k in expert_keys])
-                for expert_keys in plan.keys
-            ]
-        out = slices[0][None] if len(slices) == 1 else np.stack(slices)
+            first = maybe_t(read_hf(plan.keys[0][0]), plan.transpose)
+            out = np.empty(
+                (len(plan.keys), len(plan.keys[0])) + first.shape, first.dtype
+            )
+            out[0, 0] = first
+            del first
+            for li, expert_keys in enumerate(plan.keys):
+                for ei, k in enumerate(expert_keys):
+                    if li or ei:
+                        out[li, ei] = maybe_t(read_hf(k), plan.transpose)
         # unrolled (layer_{i}) paths carry no leading layer dim
-        return out[0] if _normalize(name)[0].startswith("layer_") else out
+        return out[0] if parts[0].startswith("layer_") else out
 
     def unconsumed() -> list[str]:
         inert = {"lm_head.weight"} if config.tie_embeddings else set()
@@ -408,11 +418,35 @@ def save_hf_checkpoint(
     shard is written (and freed) as soon as it fills — peak host memory is
     the source params + ONE shard (max_shard_size), matching the
     one-leaf-at-a-time property of the load path, not 2x the model.
+
+    Addressability: every leaf must be host-readable from process 0 —
+    single-host (sharded or not) or fully-replicated params. Params
+    sharded ACROSS hosts (a multi-host pod mesh) cannot be np.asarray'd
+    here; gather them first (``accelerator.get_state_dict(params)``, or
+    re-shard via ``dist_checkpoint`` save+merge). This function checks
+    and raises rather than letting jax surface a cryptic
+    'non-addressable devices' error mid-write.
     """
     import jax
 
-    from ..checkpointing import _save_named, parse_size
+    from ..checkpointing import _save_named, flatten_tree, parse_size
 
+    for name, leaf in flatten_tree(params).items():
+        arr = leaf.value if hasattr(leaf, "value") else leaf
+        if (
+            hasattr(arr, "is_fully_addressable")
+            and not arr.is_fully_addressable
+            # fully-replicated multi-host arrays np.asarray fine from any
+            # process (jax reads the local copy) — only CROSS-host shards
+            # are unexportable from process 0
+            and not getattr(arr, "is_fully_replicated", False)
+        ):
+            raise ValueError(
+                f"param {name!r} is sharded across hosts (not fully "
+                "addressable); gather before export — e.g. "
+                "accelerator.get_state_dict(params), or save with "
+                "dist_checkpoint and merge-weights"
+            )
     os.makedirs(save_directory, exist_ok=True)
     if jax.process_index() != 0:
         return
@@ -472,6 +506,8 @@ def save_hf_checkpoint(
         "rms_norm_eps": config.rms_norm_eps,
         "tie_word_embeddings": config.tie_embeddings,
     }
+    if config.rope_scaling:
+        hf_cfg["rope_scaling"] = config.rope_scaling
     if config.num_experts:
         hf_cfg["num_local_experts"] = config.num_experts
         hf_cfg["num_experts_per_tok"] = config.num_experts_per_tok

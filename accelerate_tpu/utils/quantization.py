@@ -275,14 +275,38 @@ def load_and_quantize_model(
     checkpoint: str,
     config: QuantizationConfig,
     device: Optional[jax.Device] = None,
+    model_config: Any = None,
+    hf_format: Optional[bool] = None,
 ) -> Any:
     """Stream a checkpoint and quantize tensor-by-tensor — peak host RAM is
     ONE full tensor, the property ``load_and_quantize_model`` gets from
-    loading shard-by-shard (reference utils/bnb.py:44,199)."""
+    loading shard-by-shard (reference utils/bnb.py:44,199).
+
+    Reads BOTH checkpoint formats, like the reference (whose bnb path
+    exists precisely to quantize real hub models on load, utils/bnb.py:44):
+    native flat-name safetensors, and HF transformers conventions
+    (auto-detected, or forced via ``hf_format=True``) assembled through
+    :func:`~.hf_interop.hf_native_reader` — per-layer keys stacked into
+    the nn.scan layout, transposes, tied embeddings. ``model_config``: a
+    TransformerConfig for the HF mapping; inferred from the sibling
+    ``config.json`` when omitted.
+    """
     from ..big_modeling import _lazy_checkpoint_reader
     from ..checkpointing import _path_str
+    from .hf_interop import (
+        hf_native_reader,
+        infer_config_from_hf,
+        is_hf_checkpoint,
+    )
 
-    read = _lazy_checkpoint_reader(checkpoint)
+    if hf_format is None:
+        hf_format = is_hf_checkpoint(checkpoint)
+    if hf_format:
+        if model_config is None:
+            model_config = infer_config_from_hf(checkpoint)
+        read = hf_native_reader(checkpoint, model_config)
+    else:
+        read = _lazy_checkpoint_reader(checkpoint)
     flat, treedef = jax.tree_util.tree_flatten_with_path(abstract_params)
     leaves = []
     for path, template in flat:
@@ -311,4 +335,14 @@ def load_and_quantize_model(
             leaves.append(
                 jax.device_put(val, device) if device is not None else val
             )
+    leftover = getattr(read, "unconsumed", lambda: [])()
+    if leftover:
+        # same contract as load_checkpoint_and_dispatch: a tensor the
+        # mapping never requested means the checkpoint holds parameters
+        # this architecture cannot represent — quantized garbage is still
+        # garbage, so fail loudly
+        raise ValueError(
+            f"HF checkpoint tensors not consumed by the parameter mapping "
+            f"(first 8): {leftover[:8]}"
+        )
     return jax.tree_util.tree_unflatten(treedef, leaves)
