@@ -545,7 +545,8 @@ def load_checkpoint_and_dispatch(
             raise ValueError(
                 f"HF checkpoint tensors not consumed by the parameter "
                 f"mapping (first 8): {leftover[:8]} — the checkpoint's "
-                "architecture does not match the Llama/Mixtral layout"
+                "architecture does not match any supported mapping "
+                "(Llama/Mixtral/GPT-2)"
             )
 
     if mesh is not None:

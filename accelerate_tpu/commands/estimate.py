@@ -64,7 +64,7 @@ def estimate_from_config(preset_or_json: str, dtype: str = "bfloat16",
                          grad_accum: bool = False, batch_size: int = 8,
                          seq_len: int = 2048,
                          remat: Optional[str] = "dots") -> dict:
-    from ..models import CausalLM, TransformerConfig
+    from ..models import TransformerConfig, causal_model_for
 
     presets = {
         "tiny": TransformerConfig.tiny,
@@ -94,7 +94,9 @@ def estimate_from_config(preset_or_json: str, dtype: str = "bfloat16",
             f"unknown preset {preset_or_json!r}; options: {sorted(presets)} "
             "or a config.json path"
         )
-    model = CausalLM(cfg)
+    # arch-dispatched (gpt2 preset -> GPT2LM): the byte estimate must
+    # count the parameters of the model that will actually run
+    model = causal_model_for(cfg)
     abstract = jax.eval_shape(
         lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32)),
         jax.random.PRNGKey(0),

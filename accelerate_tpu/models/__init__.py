@@ -8,13 +8,23 @@ TP, SP or EP without edits — the whole point of the GSPMD redesign.
 """
 
 from .config import TransformerConfig
+from .gpt2 import GPT2LM
 from .seq2seq import Seq2SeqLM
 from .transformer import CausalLM, SequenceClassifier, count_params
 
 __all__ = [
     "TransformerConfig",
     "CausalLM",
+    "GPT2LM",
     "SequenceClassifier",
     "Seq2SeqLM",
+    "causal_model_for",
     "count_params",
 ]
+
+
+def causal_model_for(config: TransformerConfig):
+    """The decoder-LM module class instance matching ``config.arch`` —
+    lets arch-agnostic call sites (examples, estimate-memory, interop
+    tests) mirror the reference's AutoModel dispatch."""
+    return GPT2LM(config) if config.arch == "gpt2" else CausalLM(config)

@@ -56,6 +56,12 @@ def validate_rope_scaling(scaling: Optional[dict]) -> None:
 
 @dataclass
 class TransformerConfig:
+    # model family: "llama" (the modern default — RMSNorm/rope/SwiGLU,
+    # models/transformer.py) or "gpt2" (classic — LayerNorm/learned
+    # positions/biases/GELU, models/gpt2.py). Selects the HF parameter
+    # mapping in utils/hf_interop.py; build the matching module class
+    # (CausalLM vs GPT2LM).
+    arch: str = "llama"
     vocab_size: int = 32000
     hidden_size: int = 512
     intermediate_size: int = 1408
@@ -82,13 +88,15 @@ class TransformerConfig:
     # MoE (Mixtral family); 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
-    # "ragged": grouped-matmul dispatch (jax.lax.ragged_dot) — exact math
-    # (no capacity padding, no token drops) at capacity-schedule speed;
+    # "auto" (default): "ragged" unless the live mesh has ep_size>1, then
+    # "capacity". "ragged": grouped-matmul dispatch (jax.lax.ragged_dot)
+    # — exact math (no capacity padding, no token drops), measured FASTER
+    # than capacity at bench shapes (ops/moe.py docstring numbers);
     # single-chip/dp only. "capacity": GShard-style static-shape dispatch
     # — the expert-parallel (ep_size>1) path, FLOPs scale with
     # K*capacity_factor, overflow tokens drop. "dense": every expert sees
     # every token (the exact-math test oracle, O(E) FLOPs)
-    moe_dispatch: str = "capacity"
+    moe_dispatch: str = "auto"
     moe_capacity_factor: float = 2.0
     # fp8 projections: e4m3 fwd / e5m2 bwd matmuls (ops/fp8.py) — the
     # TransformerEngine capability; pair with mixed_precision="fp8"
@@ -101,6 +109,10 @@ class TransformerConfig:
     dtype: str = "float32"  # activation dtype at apply time
 
     def __post_init__(self):
+        if self.arch not in ("llama", "gpt2"):
+            raise ValueError(
+                f"unknown arch {self.arch!r}; supported: llama, gpt2"
+            )
         # an unsupported/underspecified rope_scaling silently ignored (or
         # crashing only at trace time) would pass every weight check and
         # still diverge from the source model
@@ -142,12 +154,17 @@ class TransformerConfig:
 
     @classmethod
     def gpt2(cls, **kw) -> "TransformerConfig":
+        """The FAITHFUL classic architecture (models/gpt2.GPT2LM):
+        learned positions, LayerNorm, biases, GELU — real ``gpt2`` hub
+        checkpoints load with matching logits."""
+        kw.setdefault("arch", "gpt2")
         kw.setdefault("vocab_size", 50257)
         kw.setdefault("hidden_size", 768)
         kw.setdefault("intermediate_size", 3072)
         kw.setdefault("num_layers", 12)
         kw.setdefault("num_heads", 12)
         kw.setdefault("max_seq_len", 1024)
+        kw.setdefault("rms_norm_eps", 1e-5)
         kw.setdefault("tie_embeddings", True)
         return cls(**kw)
 

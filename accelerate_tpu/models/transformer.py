@@ -288,18 +288,27 @@ class MoE(nn.Module):
         w_down = epar("down_proj", (E, f, h), ("expert", "mlp", "embed"))
 
         xc = x.astype(dtype)
-        if cfg.moe_dispatch == "ragged":
-            from ..ops.moe import moe_ragged
-            from ..parallel.sharding import live_mesh
+        from ..parallel.sharding import live_mesh
 
-            mesh = live_mesh()
-            if mesh is not None and mesh.shape.get("ep", 1) > 1:
+        mesh = live_mesh()
+        ep_live = mesh is not None and mesh.shape.get("ep", 1) > 1
+        dispatch = cfg.moe_dispatch
+        if dispatch == "auto":
+            # ragged is exact AND measured faster on a single chip
+            # (ops/moe.py numbers), but its data-dependent group sizes
+            # cannot shard over ep — capacity's static all-to-all is the
+            # expert-parallel path
+            dispatch = "capacity" if ep_live else "ragged"
+        if dispatch == "ragged":
+            from ..ops.moe import moe_ragged
+
+            if ep_live:
                 # data-dependent group sizes cannot shard over ep: GSPMD
                 # would all-gather the full expert weights everywhere
                 raise ValueError(
                     "moe_dispatch='ragged' does not compose with ep_size>1;"
                     " use 'capacity' (static all-to-all) for expert "
-                    "parallelism"
+                    "parallelism, or 'auto' to pick per-mesh"
                 )
 
             out = moe_ragged(
@@ -310,7 +319,7 @@ class MoE(nn.Module):
                 w_up.astype(dtype),
                 w_down.astype(dtype),
             ).reshape(b, s, h)
-        elif cfg.moe_dispatch == "capacity":
+        elif dispatch == "capacity":
             def experts_fn(buf):  # (E, C, h) -> (E, C, h)
                 hidden = jnp.einsum("ech,ehf->ecf", buf, w_gate.astype(dtype))
                 hidden = nn.silu(hidden) * jnp.einsum(
@@ -326,7 +335,7 @@ class MoE(nn.Module):
                 E,
                 capacity_factor=cfg.moe_capacity_factor,
             ).reshape(b, s, h)
-        elif cfg.moe_dispatch == "dense":
+        elif dispatch == "dense":
             # combine weights as dense (B,S,E): zero for unselected experts
             combine = jnp.zeros_like(logits).at[
                 jnp.arange(b)[:, None, None],
@@ -341,8 +350,8 @@ class MoE(nn.Module):
             out = jnp.einsum("ebsh,bse->bsh", expert_out, combine.astype(dtype))
         else:
             raise ValueError(
-                f"unknown moe_dispatch {cfg.moe_dispatch!r}; use 'ragged', "
-                "'capacity' or 'dense'"
+                f"unknown moe_dispatch {cfg.moe_dispatch!r}; use 'auto', "
+                "'ragged', 'capacity' or 'dense'"
             )
         self.sow(
             "intermediates", "moe_aux_loss", load_balancing_loss(logits, sel, E)

@@ -69,12 +69,14 @@ def moe_ragged(
     exactly ``T*K`` token-expert pairs of FLOPs (the capacity schedule
     computes ``capacity_factor`` times that and drops overflow).
 
-    Measured at the bench MoE shapes on v5e (bf16): fwd+bwd ~11% faster
-    than capacity-1.25 WITHOUT remat (23.7 vs 26.6 ms/layer), roughly
-    equal under remat="dots" (XLA's ragged_dot is not a plain dot, so the
-    dots policy recomputes it in backward). Pick it for EXACTNESS — the
-    math equals the dense oracle (every selected pair computed, weighted,
-    summed; no silently dropped tokens), at capacity-schedule speed.
+    Measured on v5e (bf16, B=16, S=1024, E=8, K=2, round-4 sweep): at
+    Mixtral-width experts (h=4096, f=3584, L=1) ragged reaches 0.516 MFU
+    vs capacity-1.25's 0.490 (no remat) / 0.475 (remat="dots") — ~5-9%
+    faster AND exact. Under plain remat="dots" the advantage inverts
+    (the dots policy recomputes ragged_dot in backward); use the
+    "dots_ragged" policy (models/transformer._REMAT_POLICIES), which
+    saves grouped-matmul outputs too (h=4096: 0.509 with dots_ragged).
+    This is why ``moe_dispatch="auto"`` resolves to ragged at ep==1.
 
     Fully differentiable (ragged_dot has grad rules; sort / gather /
     scatter-add are linear).
@@ -82,7 +84,9 @@ def moe_ragged(
     Use on single-chip / data-parallel meshes. With ``ep_size > 1`` the
     per-expert group sizes are data-dependent, which GSPMD cannot shard
     over the ep axis — the capacity schedule (static all-to-all shapes)
-    remains the expert-parallel path.
+    remains the expert-parallel path. (A manual shard_map EP path over
+    ``jax.lax.ragged_all_to_all`` could lift this; the measured capacity
+    ceiling at ep>1 is the documented trade until then.)
 
     ``x``: (T, h); ``sel``/``weights``: (T, K); ``w_gate``/``w_up``:
     (E, h, f); ``w_down``: (E, f, h). Returns (T, h).

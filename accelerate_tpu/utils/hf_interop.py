@@ -28,13 +28,17 @@ rotation (HF ``rotate_half`` == models/transformer.rope), so weights
 interchange without any permutation of head dims.
 
 Architectures covered: the Llama family (Llama-2/3/3.1+ incl. GQA,
-llama3/linear rope scaling, tied or untied heads) and Mixtral-style MoE
+llama3/linear rope scaling, tied or untied heads), Mixtral-style MoE
 — the BASELINE.md targets (Llama-3-8B FSDP, Mixtral 8x7B EP,
-Llama-3-70B device_map="auto").
-BERT/GPT-2/T5 checkpoints do NOT map: this package's encoder/seq2seq are
+Llama-3-70B device_map="auto") — and classic GPT-2 via the faithful
+:class:`~...models.gpt2.GPT2LM` (learned positions, LayerNorm, biases,
+fused c_attn; HF Conv1D already stores ``(in, out)`` so that mapping has
+no transposes).
+BERT/T5 checkpoints do NOT map: this package's encoder/seq2seq are
 modernized architectures (RMSNorm + rope + SwiGLU, no biases) with no
 faithful parameter correspondence; they train from scratch or load
-native checkpoints.
+native checkpoints. README.md carries the user-facing compatibility
+matrix.
 """
 
 from __future__ import annotations
@@ -97,21 +101,29 @@ def list_checkpoint_keys(checkpoint: str) -> list[str]:
 
 def is_hf_checkpoint(checkpoint: str) -> bool:
     """True when the checkpoint uses HF transformers key conventions
-    (``model.embed_tokens.weight`` / ``model.layers.{i}...``) rather than
-    this package's native ``//``-joined pytree paths."""
+    (``model.embed_tokens.weight`` / ``model.layers.{i}...`` for the
+    Llama family, ``transformer.wte.weight`` / ``transformer.h.{i}...``
+    for GPT-2) rather than this package's native ``//``-joined pytree
+    paths."""
     try:
         keys = list_checkpoint_keys(checkpoint)
     except (FileNotFoundError, OSError):
         return False
     return any(
-        k == "model.embed_tokens.weight" or k.startswith("model.layers.")
+        k == "model.embed_tokens.weight"
+        or k.startswith("model.layers.")
+        or k == "transformer.wte.weight"
+        or k.startswith("transformer.h.")
         for k in keys
     )
 
 
 def detect_hf_arch(keys) -> str:
-    """"mixtral" when MoE expert keys are present, else "llama"."""
+    """"gpt2" on transformer.h.* keys, "mixtral" when MoE expert keys are
+    present, else "llama"."""
     for k in keys:
+        if k.startswith("transformer.h.") or k == "transformer.wte.weight":
+            return "gpt2"
         if ".block_sparse_moe." in k:
             return "mixtral"
     return "llama"
@@ -131,6 +143,28 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
     with open(cfg_path) as f:
         hf = json.load(f)
     model_type = hf.get("model_type", "llama")
+    if model_type == "gpt2":
+        act = hf.get("activation_function", "gelu_new")
+        if act not in ("gelu_new", "gelu_pytorch_tanh"):
+            # the native GPT2LM hard-codes tanh-GELU; a relu/gelu-exact
+            # checkpoint would load every tensor and still diverge
+            raise ValueError(
+                f"GPT-2 activation_function {act!r} is not the tanh GELU "
+                "the native GPT2LM implements"
+            )
+        kw = dict(
+            arch="gpt2",
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["n_embd"],
+            intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
+            num_layers=hf["n_layer"],
+            num_heads=hf["n_head"],
+            max_seq_len=hf.get("n_positions", hf.get("n_ctx", 1024)),
+            rms_norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=True,  # GPT-2 always ties
+        )
+        kw.update(overrides)
+        return TransformerConfig(**kw)
     # rope_scaling (llama3 / linear applied natively; yarn etc. rejected)
     # is validated by TransformerConfig.__post_init__ — the construction
     # below fails loudly, including on parameter keys missing for the
@@ -143,7 +177,7 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
         # them would succeed and generate garbage.
         raise ValueError(
             f"HF model_type {model_type!r} is not supported by the "
-            "Llama/Mixtral parameter mapping; supported: llama, mixtral"
+            "parameter mappings; supported: llama, mixtral, gpt2"
         )
     kw = dict(
         vocab_size=hf["vocab_size"],
@@ -200,9 +234,53 @@ class _HfPlanEntry:
         self.keys, self.stack, self.transpose = keys, stack, transpose
 
 
+# GPT-2 maps: native (sub-)path -> HF suffix. Conv1D stores (in, out) =
+# the flax kernel layout, so NOTHING transposes.
+_GPT2_TOP = {
+    ("wte", "embedding"): "transformer.wte.weight",
+    ("wpe", "embedding"): "transformer.wpe.weight",
+    ("ln_f", "scale"): "transformer.ln_f.weight",
+    ("ln_f", "bias"): "transformer.ln_f.bias",
+}
+_GPT2_PARAM = {"kernel": "weight", "scale": "weight", "bias": "bias"}
+_GPT2_INNER = {
+    ("ln_1",): "ln_1",
+    ("ln_2",): "ln_2",
+    ("attn", "c_attn"): "attn.c_attn",
+    ("attn", "c_proj"): "attn.c_proj",
+    ("mlp", "c_fc"): "mlp.c_fc",
+    ("mlp", "c_proj"): "mlp.c_proj",
+}
+
+
+def _plan_for_gpt2(parts: tuple[str, ...], config) -> _HfPlanEntry:
+    """GPT-2 assembly plan (classic-arch interop, models/gpt2.py):
+    ``transformer.h.{i}.*`` per-layer keys stack onto the scan layout, no
+    transposes (HF Conv1D already stores ``(in, out)``)."""
+    if parts in _GPT2_TOP:
+        return _HfPlanEntry([_GPT2_TOP[parts]], 0, False)
+    first = parts[0]
+    if first == "layers":
+        idxs: list[int] = list(range(config.num_layers))
+    else:
+        m = re.fullmatch(r"layer_(\d+)", first)
+        if not m:
+            raise KeyError(f"no GPT-2 HF mapping for native path {parts}")
+        idxs = [int(m.group(1))]
+    inner, param = parts[1:-1], parts[-1]
+    if inner in _GPT2_INNER and param in _GPT2_PARAM:
+        suffix = f"{_GPT2_INNER[inner]}.{_GPT2_PARAM[param]}"
+        return _HfPlanEntry(
+            [f"transformer.h.{i}.{suffix}" for i in idxs], 1, False
+        )
+    raise KeyError(f"no GPT-2 HF mapping for native path {parts}")
+
+
 def _plan_for(parts: tuple[str, ...], config) -> _HfPlanEntry:
     """Assembly plan for one native param path; raises KeyError for paths
     with no HF counterpart."""
+    if getattr(config, "arch", "llama") == "gpt2":
+        return _plan_for_gpt2(parts, config)
     L = config.num_layers
 
     def layer_indices(first: str) -> tuple[list[int], tuple[str, ...]]:
@@ -345,6 +423,9 @@ def hf_native_reader(
             if k not in consumed
             and k not in inert
             and not k.endswith(".rotary_emb.inv_freq")
+            # GPT-2 causal-mask buffers (older transformers persisted them)
+            and not k.endswith(".attn.bias")
+            and not k.endswith(".attn.masked_bias")
         )
 
     read_native.unconsumed = unconsumed
@@ -490,6 +571,24 @@ def save_hf_checkpoint(
                 indent=2,
                 sort_keys=True,
             )
+    if getattr(config, "arch", "llama") == "gpt2":
+        hf_cfg = {
+            "architectures": ["GPT2LMHeadModel"],
+            "model_type": "gpt2",
+            "vocab_size": config.vocab_size,
+            "n_embd": config.hidden_size,
+            "n_inner": config.intermediate_size,
+            "n_layer": config.num_layers,
+            "n_head": config.num_heads,
+            "n_positions": config.max_seq_len,
+            "n_ctx": config.max_seq_len,
+            "layer_norm_epsilon": config.rms_norm_eps,
+            "activation_function": "gelu_new",
+            "tie_word_embeddings": True,
+        }
+        with open(os.path.join(save_directory, "config.json"), "w") as f:
+            json.dump(hf_cfg, f, indent=2, sort_keys=True)
+        return
     hf_cfg = {
         "architectures": [
             "MixtralForCausalLM" if config.num_experts else "LlamaForCausalLM"

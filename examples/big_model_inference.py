@@ -61,7 +61,7 @@ def main():
     )
     parser.add_argument(
         "--hf_checkpoint", type=str, default=None,
-        help="Directory with an HF-layout (Llama/Mixtral) safetensors "
+        help="Directory with an HF-layout (Llama/Mixtral/GPT-2) safetensors "
         "checkpoint + config.json; replaces the synthetic checkpoint",
     )
     parser.add_argument(
@@ -77,11 +77,13 @@ def main():
 
     load_kwargs = {}
     if args.hf_checkpoint is not None:
+        from accelerate_tpu.models import causal_model_for
         from accelerate_tpu.utils.hf_interop import infer_config_from_hf
 
         ckpt_dir = args.hf_checkpoint
         cfg = infer_config_from_hf(ckpt_dir)
-        model = CausalLM(cfg)
+        # arch-dispatched: CausalLM for Llama/Mixtral, GPT2LM for gpt2
+        model = causal_model_for(cfg)
         # pass the parsed config through so each load call doesn't
         # re-detect the format and re-parse config.json
         load_kwargs = {"config": cfg, "hf_format": True}
