@@ -228,8 +228,19 @@ class MLP(nn.Module):
         dtype = _dtype(cfg)
         proj = _make_proj(cfg, dtype)
 
-        gate = proj("gate_proj", cfg.intermediate_size, ("embed", "mlp"))(x)
-        up = proj("up_proj", cfg.intermediate_size, ("embed", "mlp"))(x)
+        # named so the "save_mlp" remat policy can keep exactly these two
+        # f-wide activations (the expensive recompute in backward) while
+        # everything else recomputes — the long-context middle ground
+        # between "full" (recomputes all matmuls) and "dots" (saves every
+        # matmul output, OOM at S=8192 on 16G)
+        gate = checkpoint_name(
+            proj("gate_proj", cfg.intermediate_size, ("embed", "mlp"))(x),
+            "mlp_gate_out",
+        )
+        up = checkpoint_name(
+            proj("up_proj", cfg.intermediate_size, ("embed", "mlp"))(x),
+            "mlp_up_out",
+        )
         return proj("down_proj", cfg.hidden_size, ("mlp", "embed"))(
             nn.silu(gate) * up
         )
@@ -368,8 +379,11 @@ class Block(nn.Module):
         from ..parallel.sharding import constrain_activations
 
         cfg = self.config
-        h = x + Attention(cfg, decode=self.decode, name="attn")(
-            RMSNorm(cfg, name="attn_norm")(x), positions, mask, kv_lengths
+        h = checkpoint_name(
+            x + Attention(cfg, decode=self.decode, name="attn")(
+                RMSNorm(cfg, name="attn_norm")(x), positions, mask, kv_lengths
+            ),
+            "attn_res",
         )
         ff = MoE(cfg, name="moe") if cfg.num_experts > 0 else MLP(cfg, name="mlp")
         # pin the residual stream's layout once per layer so GSPMD cannot
@@ -418,6 +432,15 @@ _REMAT_POLICIES = {
     ),
     "save_attn": lambda: jax.checkpoint_policies.save_only_these_names(
         "attn_out"
+    ),
+    # the long-context (S=8k, B=1) middle ground: keep the f-wide MLP
+    # activations, the attention output, and the residual mid — backward
+    # then recomputes only the attention path (norm+qkv+kernel, the small
+    # fraction of layer FLOPs) instead of the whole layer ("full") while
+    # saving far less than "dots" (which keeps every matmul output and
+    # OOMs at S=8192 on 16G chips)
+    "save_mlp": lambda: jax.checkpoint_policies.save_only_these_names(
+        "attn_out", "attn_res", "mlp_gate_out", "mlp_up_out"
     ),
 }
 

@@ -192,7 +192,30 @@ def ring_attention(
     )
     from jax import shard_map
 
+    # sp under pp: when this runs INSIDE the pipeline's partial-manual
+    # stage body (parallel/pipeline.py — pp is already Manual there), the
+    # inner shard_map must be built on the tracing context's abstract
+    # mesh; the concrete mesh no longer matches and jax rejects it. The
+    # nesting is sound: sp is an auto axis of the stage body, so shapes
+    # here are global over sp and this shard_map manualizes exactly sp.
+    # check_vma must be ON in that nested position — with it off, the
+    # transpose of this shard_map under the stage's jax.vjp loses the
+    # replication accounting and produces silently wrong cotangents
+    # (verified by the pp x sp equivalence test; loss matches, grads
+    # diverge ~1e3 without it).
+    sm_mesh = mesh
+    nested_manual = False
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        nested_manual = any(
+            "Manual" in str(t) for t in getattr(ctx, "axis_types", ())
+        )
+        if nested_manual:
+            sm_mesh = ctx
+    except Exception:  # noqa: BLE001 — older jax without abstract meshes
+        pass
+
     return shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+        body, mesh=sm_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=nested_manual,
     )(q, k, v)

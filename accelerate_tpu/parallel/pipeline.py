@@ -13,13 +13,16 @@ activations to the next stage. Backward falls out of jax.grad through the
 scan (reverse pipeline schedule), so the same ``unified_step`` trains a
 pipelined model with zero engine code.
 
-Composition rules (v2): pp composes with dp/fsdp batch sharding AND with
-tp — the stage shard_map is PARTIAL-MANUAL (``axis_names={"pp"}``): only
-the pp axis is manual; every other mesh axis stays automatic, so GSPMD
-partitions the stage body over tp/dp/fsdp and inserts their collectives
-inside each pipeline stage (the Megatron pp x tp layout, reference
-utils/dataclasses.py:1338, reached here with zero engine code). sp/ep
-inside a stage remain rejected in :func:`validate_pipeline_plugin`.
+Composition rules (v3): pp composes with dp/fsdp batch sharding, with tp,
+AND with sp — the stage shard_map is PARTIAL-MANUAL
+(``axis_names={"pp"}``): only the pp axis is manual; every other mesh
+axis stays automatic, so GSPMD partitions the stage body over
+tp/dp/fsdp/sp and inserts their collectives inside each pipeline stage
+(the Megatron pp x tp and pp x sp layouts, reference
+utils/dataclasses.py:1323,1338, reached with zero engine code). Ring
+attention under pp nests its own sp shard_map on the context mesh
+(ops/ring_attention.py). ep inside a stage remains rejected in
+:func:`validate_pipeline_plugin`.
 
 Two schedules:
 
@@ -107,25 +110,30 @@ def validate_pipeline_plugin(
     pp = sizes.pop("pp")
     if pp in (1, -1):
         return
-    # tp composes since v2 via PARTIAL-MANUAL shard_map (tp stays an auto
-    # axis inside the stage body) — only available when jax's shard_map
-    # supports axis_names; on older jax full-manual would silently
-    # replicate tp (duplicate compute + per-step weight all-gather), so
-    # reject it there. sp/ep would need the ring / all-to-all collectives
-    # nested under the pp schedule — still rejected everywhere.
+    # tp AND sp compose since partial-manual shard_map (both stay auto
+    # axes inside the stage body; ring attention nests its own sp
+    # shard_map on the context mesh — ops/ring_attention.py). On older
+    # jax full-manual would silently replicate tp (duplicate compute +
+    # per-step weight all-gather) and cannot nest the sp ring, so both
+    # are rejected there. ep under pp would put the expert all-to-all
+    # under the schedule — still rejected everywhere (untested).
     tp = (
         resolved_shape["tp"] if resolved_shape is not None else plugin.tp_size
     )
-    if tp not in (1, -1) and not _PARTIAL_MANUAL:
-        raise NotImplementedError(
-            f"pp_size={pp} with tp_size={tp} needs jax shard_map partial-"
-            "manual mode (axis_names), unavailable in this jax version"
-        )
+    sp = sizes.pop("sp_size")
+    if not _PARTIAL_MANUAL:
+        for name, v in (("tp_size", tp), ("sp_size", sp)):
+            if v not in (1, -1):
+                raise NotImplementedError(
+                    f"pp_size={pp} with {name}={v} needs jax shard_map "
+                    "partial-manual mode (axis_names), unavailable in this "
+                    "jax version"
+                )
     offending = {k: v for k, v in sizes.items() if v not in (1,)}
     if offending:
         raise NotImplementedError(
             f"pipeline parallelism (pp_size={pp}) cannot yet be "
-            f"combined with {offending}; use pp with dp/fsdp/tp only"
+            f"combined with {offending}; use pp with dp/fsdp/tp/sp only"
         )
     if plugin.num_micro_batches < pp:
         raise ValueError(

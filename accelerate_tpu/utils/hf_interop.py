@@ -118,15 +118,6 @@ def is_hf_checkpoint(checkpoint: str) -> bool:
     )
 
 
-def detect_hf_arch(keys) -> str:
-    """"gpt2" on transformer.h.* keys, "mixtral" when MoE expert keys are
-    present, else "llama"."""
-    for k in keys:
-        if k.startswith("transformer.h.") or k == "transformer.wte.weight":
-            return "gpt2"
-        if ".block_sparse_moe." in k:
-            return "mixtral"
-    return "llama"
 
 
 def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
@@ -151,6 +142,19 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
             raise ValueError(
                 f"GPT-2 activation_function {act!r} is not the tanh GELU "
                 "the native GPT2LM implements"
+            )
+        # attention-math variants with IDENTICAL tensor layouts: every
+        # weight would map and logits would silently diverge — same
+        # rejection class as activation_function above
+        if (
+            not hf.get("scale_attn_weights", True)
+            or hf.get("scale_attn_by_inverse_layer_idx", False)
+            or hf.get("reorder_and_upcast_attn", False)
+        ):
+            raise ValueError(
+                "GPT-2 checkpoints with scale_attn_weights=False, "
+                "scale_attn_by_inverse_layer_idx or reorder_and_upcast_attn "
+                "use attention math the native GPT2LM does not implement"
             )
         kw = dict(
             arch="gpt2",

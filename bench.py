@@ -98,11 +98,23 @@ def _configs(on_tpu: bool):
     )
     longseq = TransformerConfig(
         # the long-context regime (VERDICT r2 #10: the S=8k single-chip
-        # flash point): S^2 score tensors never materialize, remat="full"
-        # keeps saved state O(S)
+        # flash point): S^2 score tensors never materialize. Round-4
+        # remat sweep at this shape (B=1, adamw, MFU):
+        #   L=3 remat="full"       0.475   (round-3 config; 0.63 dense
+        #       ceiling x 6/8 full-recompute bound = 0.47 — the number
+        #       is exactly the remat tax, not kernel inefficiency)
+        #   L=3 remat="save_attn"  0.474   (kernel fwd recompute is tiny)
+        #   L=3 remat="dots"       OOM     (saves every matmul output)
+        #   L=3 remat="save_mlp"   OOM by 1.0G (AdamW state crowds it out)
+        #   L=2 remat="full"       0.473
+        #   L=2 remat="save_mlp"   0.505   <- this config (keeps f-wide
+        #       MLP activations; backward recomputes only the attn path)
+        # Residual gap to 0.60 is structural at B=1/S=8192: ~11% of
+        # counted FLOPs are attention (flash bwd runs below dense-matmul
+        # MXU efficiency) plus the remaining attn-path recompute.
         vocab_size=32000, hidden_size=4096, intermediate_size=14336,
-        num_layers=3, num_heads=32, num_kv_heads=8, max_seq_len=8192,
-        dtype="bfloat16", remat="full", attention_impl="flash",
+        num_layers=2, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+        dtype="bfloat16", remat="save_mlp", attention_impl="flash",
     )
     import dataclasses
 

@@ -520,3 +520,16 @@ def test_gpt2_export_loads_in_transformers(tmp_path):
         model.apply({"params": params}, jnp.asarray(_IDS)), dtype=np.float32
     )
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_attention_math_variants_rejected(tmp_path):
+    """GPT-2 variants with identical tensor layouts but different
+    attention math (scale_attn_by_inverse_layer_idx etc.) must fail at
+    config time, not silently diverge (code-review r4 finding)."""
+    _, path = _save_hf_gpt2(tmp_path)
+    cfg_path = os.path.join(path, "config.json")
+    hf_cfg = json.load(open(cfg_path))
+    hf_cfg["scale_attn_by_inverse_layer_idx"] = True
+    json.dump(hf_cfg, open(cfg_path, "w"))
+    with pytest.raises(ValueError, match="attention math"):
+        infer_config_from_hf(path)
