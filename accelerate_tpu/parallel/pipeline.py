@@ -264,6 +264,7 @@ def pipeline_train_step(
     mesh: Mesh,
     num_micro_batches: int,
     batch_dim: int = 0,
+    _force_replicated_feed: bool = False,
 ) -> tuple[jax.Array, Any]:
     """One 1F1B pipeline training step: ``(loss, grads)`` in a single pass.
 
@@ -282,11 +283,12 @@ def pipeline_train_step(
     (M, ...) output buffer exists: ``loss_fn(y_mb, target_mb)`` is
     evaluated per microbatch on the last stage and only the scalar sum
     crosses stages (one psum), vs the GPipe path's full output
-    psum-broadcast. Caveat: the raw ``x``/``targets`` (M, ...) buffers are
-    still replicated onto every stage (O(M) per stage) — the (2S-1)/M
-    bound applies to the residual/output state, which dominates when the
-    per-stage block is deep; feed token ids (small) rather than
-    activations where possible.
+    psum-broadcast. The raw ``x``/``targets`` (M, ...) buffers shard over
+    pp along the microbatch dim whenever ``M % S == 0`` (each stage holds
+    M/S microbatches; the consumed one arrives by a masked psum-gather
+    from its owner each tick) — Megatron's feed discipline of giving data
+    only to the boundary stages, reference utils/megatron_lm.py:1037-1058.
+    Non-divisible M falls back to replicated buffers.
 
     ``loss_fn`` must decompose over microbatches: total loss is
     ``mean_j loss_fn(y_j, t_j)`` (any per-sample mean/sum loss qualifies).
@@ -318,7 +320,18 @@ def pipeline_train_step(
     xm = _microbatch(x, M, batch_dim)
     tm = _microbatch(targets, M, batch_dim)
     param_specs = jax.tree.map(lambda l: P(MESH_AXIS_PIPELINE), stacked_params)
-    data_spec = P()
+    # Feed discipline (Megatron feeds data only to stage 0 / targets only
+    # to the last stage, reference utils/megatron_lm.py:1037-1058): when M
+    # divides by S the (M, ...) input/target buffers SHARD over pp along
+    # the microbatch dim — each stage holds M/S microbatches and the one
+    # consumed each tick is delivered by a psum-gather from its owner
+    # (the tick's feed index is the same static value on every stage, so
+    # the gather is one masked psum of a single microbatch). Per-stage
+    # input memory drops from O(M) to O(M/S). With M % S != 0 the buffers
+    # stay replicated (correct, just the old footprint).
+    feed_sharded = M % S == 0 and not _force_replicated_feed
+    Mloc = M // S if feed_sharded else M
+    data_spec = P(MESH_AXIS_PIPELINE) if feed_sharded else P()
     t_specs = jax.tree.map(lambda _: data_spec, tm)
     R = 2 * S - 1  # ring depth: max input lifetime is 2(S-1) ticks (stage 0)
     T = M + 2 * S - 2
@@ -332,13 +345,31 @@ def pipeline_train_step(
         fwd_perm = [(i, i + 1) for i in range(S - 1)]  # i -> i+1, 0 gets zeros
         bwd_perm = [(i + 1, i) for i in range(S - 1)]  # i -> i-1, S-1 gets zeros
 
+        def fetch(local_buf, idx):
+            """Microbatch ``idx`` (a global index, identical on every
+            stage) out of a pp-sharded (Mloc, ...) buffer: the owning
+            stage contributes its slice, everyone else zeros, one psum
+            delivers it — the distributed-gather feed."""
+            if not feed_sharded:
+                return jax.lax.dynamic_index_in_dim(
+                    local_buf, idx, 0, keepdims=False
+                )
+            owner = idx // Mloc
+            piece = jax.lax.dynamic_index_in_dim(
+                local_buf, idx % Mloc, 0, keepdims=False
+            )
+            piece = jnp.where(stage == owner, piece, jnp.zeros_like(piece))
+            return jax.lax.psum(piece, MESH_AXIS_PIPELINE)
+
         def tick(carry, t):
             fwd_msg, bwd_msg, ring, dparams, loss_acc = carry
             # ---- forward sub-phase: microbatch jf = t - stage ---------- #
             jf = t - stage
             active_f = jnp.logical_and(jf >= 0, jf < M)
             jf_c = jnp.clip(jf, 0, M - 1)
-            feed = jax.lax.dynamic_index_in_dim(local_xm, jf_c, 0, keepdims=False)
+            # stage 0's feed index == the LAST stage's target index shifted
+            # by S-1 ticks; both are stage-independent statics per tick
+            feed = fetch(local_xm, jnp.clip(t, 0, M - 1))
             x_in = jnp.where(stage == 0, feed, fwd_msg)
             y = block_fn(local_params, x_in)
             slot_f = jf_c % R
@@ -346,9 +377,12 @@ def pipeline_train_step(
             ring = jax.lax.dynamic_update_index_in_dim(
                 ring, jnp.where(active_f, x_in, prev), slot_f, 0
             )
+            # targets are consumed ONLY by the last stage (loss_acc / the
+            # turned-around cotangent are masked elsewhere), so fetch at
+            # the last stage's index t - (S-1)
+            tgt_idx = jnp.clip(t - (S - 1), 0, M - 1)
             tgt = jax.tree.map(
-                lambda a: jax.lax.dynamic_index_in_dim(a, jf_c, 0, keepdims=False),
-                local_tm,
+                lambda a: fetch(a, tgt_idx), local_tm
             )
             # per-microbatch loss + cotangent — the last stage turns the
             # microbatch around within this same tick

@@ -356,6 +356,57 @@ def test_1f1b_peak_memory_beats_gpipe_autodiff():
     assert temp_1f1b * 4 < temp_gpipe, (temp_1f1b, temp_gpipe)
 
 
+def test_1f1b_feed_sharding_cuts_input_memory():
+    """The (M, ...) input/target buffers shard over pp (feed discipline,
+    VERDICT r3 weak #5): at large M the per-device argument bytes for
+    data must drop by ~the pp degree vs the replicated feed, and the
+    numbers must stay identical."""
+    from accelerate_tpu.parallel.pipeline import pipeline_train_step
+
+    Lb, Hb, M = 4, 64, 32
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (Lb, Hb, Hb)) / 8
+    }
+
+    def block(local, x):
+        def body(h, layer):
+            return h + jnp.tanh(h @ layer["w"]), None
+
+        h, _ = jax.lax.scan(body, x, local)
+        return h
+
+    plugin = ParallelismPlugin(
+        dp_size=2, pp_size=4, sharding_strategy=ShardingStrategy.NO_SHARD,
+        num_micro_batches=M,
+    )
+    mesh = build_mesh(plugin)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8 * M, Hb))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (8 * M, Hb))
+    ps = jax.device_put(params, stacked_layer_shardings(params, mesh))
+
+    def lowered(forced):
+        return jax.jit(
+            lambda p, xx, tt: pipeline_train_step(
+                block, _mse, p, xx, tt, mesh=mesh, num_micro_batches=M,
+                _force_replicated_feed=forced,
+            )
+        ).lower(ps, x, tgt).compile()
+
+    sharded, replicated = lowered(False), lowered(True)
+    arg_s = sharded.memory_analysis().argument_size_in_bytes
+    arg_r = replicated.memory_analysis().argument_size_in_bytes
+    data_bytes = x.size * 4 + tgt.size * 4
+    # replicated: every stage holds all M microbatches of x AND targets;
+    # sharded: M/4 each. The saving must be most of 3/4 of the data bytes.
+    assert arg_r - arg_s > 0.5 * data_bytes, (arg_s, arg_r, data_bytes)
+
+    l_s, g_s = sharded(ps, x, tgt)
+    l_r, g_r = replicated(ps, x, tgt)
+    np.testing.assert_allclose(float(l_s), float(l_r), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_s), jax.tree.leaves(g_r)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_unified_pipeline_step_trains():
     """accelerator.unified_pipeline_step: the 1F1B schedule + clip +
     update as ONE program, first-class through the Accelerator. Trains the
