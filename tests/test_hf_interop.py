@@ -44,7 +44,16 @@ _TINY = dict(
 )
 
 
-def _save_hf_llama(tmp_path, tie=False, dtype=None, seed=0):
+_LLAMA31_ROPE_SCALING = {
+    "rope_type": "llama3",
+    "factor": 8.0,
+    "low_freq_factor": 1.0,
+    "high_freq_factor": 4.0,
+    "original_max_position_embeddings": 32,
+}
+
+
+def _save_hf_llama(tmp_path, tie=False, dtype=None, seed=0, rope_scaling=None):
     cfg = transformers.LlamaConfig(
         vocab_size=_TINY["vocab_size"],
         hidden_size=_TINY["hidden_size"],
@@ -54,6 +63,7 @@ def _save_hf_llama(tmp_path, tie=False, dtype=None, seed=0):
         num_key_value_heads=_TINY["num_kv_heads"],
         max_position_embeddings=_TINY["max_seq_len"],
         rope_theta=_TINY["rope_theta"],
+        rope_scaling=rope_scaling,
         rms_norm_eps=_TINY["rms_norm_eps"],
         tie_word_embeddings=tie,
         attention_dropout=0.0,
@@ -276,30 +286,9 @@ def test_llama31_rope_scaled_checkpoint_logits_match_torch(tmp_path):
     loads with the scaled rope applied and logits still match transformers
     — closing VERDICT r3 missing #1 (previously these checkpoints were
     rejected; most currently-shipping Llama weights are 3.1+)."""
-    rope_scaling = {
-        "rope_type": "llama3",
-        "factor": 8.0,
-        "low_freq_factor": 1.0,
-        "high_freq_factor": 4.0,
-        "original_max_position_embeddings": 32,
-    }
-    cfg = transformers.LlamaConfig(
-        vocab_size=_TINY["vocab_size"],
-        hidden_size=_TINY["hidden_size"],
-        intermediate_size=_TINY["intermediate_size"],
-        num_hidden_layers=_TINY["num_layers"],
-        num_attention_heads=_TINY["num_heads"],
-        num_key_value_heads=_TINY["num_kv_heads"],
-        max_position_embeddings=_TINY["max_seq_len"],
-        rope_theta=_TINY["rope_theta"],
-        rope_scaling=rope_scaling,
-        rms_norm_eps=_TINY["rms_norm_eps"],
-        attention_dropout=0.0,
+    hf_model, path = _save_hf_llama(
+        tmp_path, seed=6, rope_scaling=_LLAMA31_ROPE_SCALING
     )
-    torch.manual_seed(6)
-    hf_model = transformers.LlamaForCausalLM(cfg).eval()
-    path = str(tmp_path / "hf_llama31")
-    hf_model.save_pretrained(path, safe_serialization=True)
 
     config = infer_config_from_hf(path, attention_impl="xla")
     assert config.rope_scaling is not None
@@ -317,6 +306,34 @@ def test_llama31_rope_scaled_checkpoint_logits_match_torch(tmp_path):
     plain = dataclasses.replace(config, rope_scaling=None)
     unscaled = _native_logits(plain, params, _IDS)
     assert np.abs(unscaled - theirs).max() > np.abs(ours - theirs).max()
+
+
+def test_llama31_rope_scaled_generation_matches_torch_greedy(tmp_path):
+    """The KV-cache decode path applies rope scaling too (prefill AND the
+    per-token steps go through the scaled frequencies): greedy generation
+    must reproduce transformers'."""
+    from accelerate_tpu.models.generation import generate
+
+    hf_model, path = _save_hf_llama(
+        tmp_path, seed=11, rope_scaling=_LLAMA31_ROPE_SCALING
+    )
+
+    config = infer_config_from_hf(path, attention_impl="xla")
+    model = CausalLM(config)
+    params = load_checkpoint_and_dispatch(
+        _abstract(config), path, device_map={"": "cpu"}
+    )
+    prompt = jnp.asarray(_IDS[:, :8])
+    ours = generate(model, params, prompt, max_new_tokens=6)
+    with torch.no_grad():
+        theirs = hf_model.generate(
+            torch.from_numpy(np.asarray(prompt).copy()),
+            max_new_tokens=6, do_sample=False,
+        )
+    # guard the comparison alignment: an early HF eos stop would silently
+    # shift the [-6:] window onto prompt tokens (review finding)
+    assert theirs.shape[1] == prompt.shape[1] + 6, theirs.shape
+    assert np.asarray(ours)[0, -6:].tolist() == theirs[0, -6:].tolist()
 
 
 def test_linear_rope_scaling_matches_torch(tmp_path):
