@@ -70,6 +70,9 @@ class TransformerConfig:
     num_decoder_layers: Optional[int] = None
     num_heads: int = 8
     num_kv_heads: Optional[int] = None  # None -> num_heads (MHA); < heads -> GQA
+    # bias on the q/k/v projections ONLY (the Qwen2 family convention —
+    # o_proj and the MLP stay bias-free); selects the matching HF mapping
+    qkv_bias: bool = False
     head_dim: Optional[int] = None  # None -> hidden_size // num_heads
     max_seq_len: int = 2048
     rope_theta: float = 500000.0

@@ -112,26 +112,34 @@ def rope(
 
 
 def _make_proj(cfg: TransformerConfig, dtype):
-    """The shared no-bias projection factory: nn.Dense, or Fp8Dense when
+    """The shared projection factory: nn.Dense, or Fp8Dense when
     ``cfg.fp8`` (the te.Linear swap, reference utils/transformer_engine.py:36)
-    — same param layout either way, so checkpoints interchange."""
+    — same param layout either way, so checkpoints interchange. Biases are
+    off except where an architecture convention turns them on per-proj
+    (``use_bias``/``bias_axis`` — the Qwen2 q/k/v biases)."""
 
-    def proj(name, out_features, axes):
+    def proj(name, out_features, axes, use_bias=False, bias_axis=None):
         kernel_init = nn.with_partitioning(nn.initializers.lecun_normal(), axes)
+        kw = {}
+        if use_bias:
+            kw["bias_init"] = nn.with_partitioning(
+                nn.initializers.zeros_init(), (bias_axis,)
+            )
         if cfg.fp8:
             from ..ops.fp8 import Fp8Dense
 
             return Fp8Dense(
                 out_features, dtype=dtype, param_dtype=jnp.float32,
-                kernel_init=kernel_init, name=name,
+                kernel_init=kernel_init, use_bias=use_bias, name=name, **kw,
             )
         return nn.Dense(
             out_features,
-            use_bias=False,
+            use_bias=use_bias,
             dtype=dtype,
             param_dtype=jnp.float32,
             kernel_init=kernel_init,
             name=name,
+            **kw,
         )
 
     return proj
@@ -151,9 +159,18 @@ class Attention(nn.Module):
 
         proj = _make_proj(cfg, dtype)
 
-        q = proj("q_proj", q_dim, ("embed", "heads"))(x)
-        k = proj("k_proj", kv_dim, ("embed", "kv"))(x)
-        v = proj("v_proj", kv_dim, ("embed", "kv"))(x)
+        q = proj(
+            "q_proj", q_dim, ("embed", "heads"),
+            use_bias=cfg.qkv_bias, bias_axis="heads",
+        )(x)
+        k = proj(
+            "k_proj", kv_dim, ("embed", "kv"),
+            use_bias=cfg.qkv_bias, bias_axis="kv",
+        )(x)
+        v = proj(
+            "v_proj", kv_dim, ("embed", "kv"),
+            use_bias=cfg.qkv_bias, bias_axis="kv",
+        )(x)
         b, s = x.shape[:2]
         q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)

@@ -126,6 +126,7 @@ class Fp8Dense(nn.Module):
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     kernel_init: Any = nn.initializers.lecun_normal()
+    bias_init: Any = nn.initializers.zeros_init()
 
     @nn.compact
     def __call__(self, x):
@@ -137,7 +138,7 @@ class Fp8Dense(nn.Module):
         out = fp8_matmul(x.astype(jnp.float32), k.astype(jnp.float32))
         if self.use_bias:
             bias = self.param(
-                "bias", nn.initializers.zeros_init(), (self.features,),
+                "bias", self.bias_init, (self.features,),
                 self.param_dtype,
             )
             b = bias.unbox() if hasattr(bias, "unbox") else bias

@@ -28,8 +28,9 @@ rotation (HF ``rotate_half`` == models/transformer.rope), so weights
 interchange without any permutation of head dims.
 
 Architectures covered: the Llama family (Llama-2/3/3.1+ incl. GQA,
-llama3/linear rope scaling, tied or untied heads), Mixtral-style MoE
-— the BASELINE.md targets (Llama-3-8B FSDP, Mixtral 8x7B EP,
+llama3/linear rope scaling, tied or untied heads), Qwen2 (the Llama
+layout plus q/k/v biases — ``TransformerConfig.qkv_bias``), Mixtral-style
+MoE — the BASELINE.md targets (Llama-3-8B FSDP, Mixtral 8x7B EP,
 Llama-3-70B device_map="auto") — and classic GPT-2 via the faithful
 :class:`~...models.gpt2.GPT2LM` (learned positions, LayerNorm, biases,
 fused c_attn; HF Conv1D already stores ``(in, out)`` so that mapping has
@@ -174,14 +175,21 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
     # below fails loudly, including on parameter keys missing for the
     # declared type, so nothing can only blow up at trace time.
     rope_scaling = hf.get("rope_scaling")
-    if model_type not in ("llama", "mixtral"):
-        # Qwen2/Gemma/... share the model.layers.* key convention and every
+    if model_type == "qwen2" and hf.get("use_sliding_window", False):
+        # the native attention has no sliding-window masking; loading
+        # would silently change long-range behavior
+        raise ValueError(
+            "Qwen2 checkpoints with use_sliding_window=true are not "
+            "supported by the native attention"
+        )
+    if model_type not in ("llama", "mixtral", "qwen2"):
+        # Gemma/... share the model.layers.* key convention and every
         # config field this mapping reads, but differ in parameters the
-        # plan would silently drop (qkv biases, offset norms) — loading
+        # plan would silently drop (offset norms, soft-capping) — loading
         # them would succeed and generate garbage.
         raise ValueError(
             f"HF model_type {model_type!r} is not supported by the "
-            "parameter mappings; supported: llama, mixtral, gpt2"
+            "parameter mappings; supported: llama, mixtral, qwen2, gpt2"
         )
     kw = dict(
         vocab_size=hf["vocab_size"],
@@ -195,6 +203,9 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
         rope_scaling=rope_scaling,
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        # the Qwen2 convention: biases on q/k/v only (hard-wired in the
+        # arch, not a config.json field)
+        qkv_bias=model_type == "qwen2",
     )
     if hf.get("num_local_experts"):
         kw["num_experts"] = hf["num_local_experts"]
@@ -309,6 +320,15 @@ def _plan_for(parts: tuple[str, ...], config) -> _HfPlanEntry:
         if len(rest) == 3 and rest[0] == "attn" and rest[1] in _ATTN and rest[2] == "kernel":
             return _HfPlanEntry(
                 [f"{p}.self_attn.{_ATTN[rest[1]]}.weight" for p in prefix], 1, True
+            )
+        if (
+            len(rest) == 3 and rest[0] == "attn" and rest[2] == "bias"
+            and rest[1] in ("q_proj", "k_proj", "v_proj")
+            and getattr(config, "qkv_bias", False)
+        ):
+            # Qwen2-family q/k/v biases (1-D: no transpose applies)
+            return _HfPlanEntry(
+                [f"{p}.self_attn.{_ATTN[rest[1]]}.bias" for p in prefix], 1, False
             )
         if len(rest) == 2 and rest[0] in _NORMS and rest[1] == "scale":
             return _HfPlanEntry(
@@ -593,11 +613,24 @@ def save_hf_checkpoint(
         with open(os.path.join(save_directory, "config.json"), "w") as f:
             json.dump(hf_cfg, f, indent=2, sort_keys=True)
         return
+    if config.num_experts and getattr(config, "qkv_bias", False):
+        # no HF arch matches "Mixtral experts + Qwen2 qkv biases": a
+        # mixtral-labeled export would make transformers silently DROP
+        # the bias tensors (divergent logits) and the native reload
+        # would error on unconsumed keys — fail loudly instead
+        raise ValueError(
+            "no HF model_type represents num_experts>0 with qkv_bias=True; "
+            "export with qkv_bias=False or save a native checkpoint"
+        )
+    if config.num_experts:
+        arch_name, mt = "MixtralForCausalLM", "mixtral"
+    elif getattr(config, "qkv_bias", False):
+        arch_name, mt = "Qwen2ForCausalLM", "qwen2"
+    else:
+        arch_name, mt = "LlamaForCausalLM", "llama"
     hf_cfg = {
-        "architectures": [
-            "MixtralForCausalLM" if config.num_experts else "LlamaForCausalLM"
-        ],
-        "model_type": "mixtral" if config.num_experts else "llama",
+        "architectures": [arch_name],
+        "model_type": mt,
         "vocab_size": config.vocab_size,
         "hidden_size": config.hidden_size,
         "intermediate_size": config.intermediate_size,

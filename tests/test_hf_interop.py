@@ -245,9 +245,10 @@ def test_lookalike_arch_rejected(tmp_path):
     config = infer_config_from_hf(path, attention_impl="xla")
 
     # 1) unknown model_type in config.json -> infer_config_from_hf raises
+    # (qwen2 moved to SUPPORTED in round 4; gemma stays a lookalike)
     cfg_path = os.path.join(path, "config.json")
     hf_cfg = json.load(open(cfg_path))
-    hf_cfg["model_type"] = "qwen2"
+    hf_cfg["model_type"] = "gemma"
     json.dump(hf_cfg, open(cfg_path, "w"))
     with pytest.raises(ValueError, match="model_type"):
         infer_config_from_hf(path)
@@ -550,3 +551,83 @@ def test_gpt2_attention_math_variants_rejected(tmp_path):
     json.dump(hf_cfg, open(cfg_path, "w"))
     with pytest.raises(ValueError, match="attention math"):
         infer_config_from_hf(path)
+
+
+def _save_hf_qwen2(tmp_path, seed=12, **cfg_kw):
+    cfg_kw.setdefault("use_sliding_window", False)
+    cfg = transformers.Qwen2Config(
+        vocab_size=_TINY["vocab_size"],
+        hidden_size=_TINY["hidden_size"],
+        intermediate_size=_TINY["intermediate_size"],
+        num_hidden_layers=_TINY["num_layers"],
+        num_attention_heads=_TINY["num_heads"],
+        num_key_value_heads=_TINY["num_kv_heads"],
+        max_position_embeddings=_TINY["max_seq_len"],
+        rope_theta=_TINY["rope_theta"],
+        rms_norm_eps=_TINY["rms_norm_eps"],
+        tie_word_embeddings=False,
+        attention_dropout=0.0,
+        **cfg_kw,
+    )
+    torch.manual_seed(seed)
+    model = transformers.Qwen2ForCausalLM(cfg).eval()
+    path = str(tmp_path / "hf_qwen2")
+    model.save_pretrained(path, safe_serialization=True)
+    return model, path
+
+
+def test_qwen2_checkpoint_logits_match_torch(tmp_path):
+    """Qwen2 (Llama layout + q/k/v biases) loads through the qkv_bias
+    mapping with logits matching transformers — round 4 moves the family
+    from rejected-lookalike to supported."""
+    hf_model, path = _save_hf_qwen2(tmp_path)
+
+    config = infer_config_from_hf(path, attention_impl="xla")
+    assert config.qkv_bias
+    params = load_checkpoint_and_dispatch(
+        _abstract(config), path, device_map={"": "cpu"}
+    )
+    # the bias leaves really exist and carry the checkpoint values
+    assert "bias" in params["layers"]["attn"]["q_proj"]
+    ours = _native_logits(config, params, _IDS)
+    theirs = _torch_logits(hf_model, _IDS)
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # round-trip: native export declares model_type qwen2 and transformers
+    # loads it back with the biases intact
+    out = str(tmp_path / "qwen2_export")
+    save_hf_checkpoint(params, config, out)
+    assert json.load(open(os.path.join(out, "config.json")))["model_type"] == "qwen2"
+    hf2 = transformers.Qwen2ForCausalLM.from_pretrained(out).eval()
+    np.testing.assert_allclose(
+        _torch_logits(hf2, _IDS), theirs, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_qwen2_sliding_window_rejected(tmp_path):
+    """use_sliding_window=true changes attention semantics the native
+    model does not implement — reject at config time."""
+    _, path = _save_hf_qwen2(
+        tmp_path, seed=13, use_sliding_window=True, sliding_window=32,
+        max_window_layers=0,
+    )
+    with pytest.raises(ValueError, match="sliding_window"):
+        infer_config_from_hf(path)
+
+
+def test_moe_with_qkv_bias_export_rejected(tmp_path):
+    """num_experts>0 + qkv_bias=True matches no HF model_type; a
+    mixtral-labeled export would silently drop the biases in transformers
+    — save must fail loudly (code-review r4 finding)."""
+    config = TransformerConfig(
+        **_TINY, attention_impl="xla", num_experts=4, num_experts_per_tok=2,
+        qkv_bias=True, moe_dispatch="dense",
+    )
+    from accelerate_tpu.models import CausalLM as _CausalLM
+
+    model = _CausalLM(config)
+    params = model.init(
+        jax.random.PRNGKey(14), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    with pytest.raises(ValueError, match="qkv_bias"):
+        save_hf_checkpoint(params, config, str(tmp_path / "bad"))
