@@ -92,13 +92,15 @@ class TransformerConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     # "auto" (default): "ragged" unless the live mesh has ep_size>1, then
-    # "capacity". "ragged": grouped-matmul dispatch (jax.lax.ragged_dot)
-    # — exact math (no capacity padding, no token drops), measured FASTER
-    # than capacity at bench shapes (ops/moe.py docstring numbers);
-    # single-chip/dp only. "capacity": GShard-style static-shape dispatch
-    # — the expert-parallel (ep_size>1) path, FLOPs scale with
-    # K*capacity_factor, overflow tokens drop. "dense": every expert sees
-    # every token (the exact-math test oracle, O(E) FLOPs)
+    # "capacity" (the battle-tested ep path). "ragged": grouped-matmul
+    # dispatch (jax.lax.ragged_dot) — exact math at ep==1 (no padding, no
+    # drops), measured FASTER than capacity at bench shapes (ops/moe.py
+    # docstring numbers); under ep>1 it runs the shard-capacity EP
+    # schedule (ops/moe.moe_ragged_ep — ragged-packed local experts,
+    # per-SHARD headroom, drops only on whole-shard overflow).
+    # "capacity": GShard-style static-shape dispatch — FLOPs scale with
+    # K*capacity_factor, overflow tokens drop per expert. "dense": every
+    # expert sees every token (the exact-math test oracle, O(E) FLOPs)
     moe_dispatch: str = "auto"
     moe_capacity_factor: float = 2.0
     # fp8 projections: e4m3 fwd / e5m2 bwd matmuls (ops/fp8.py) — the

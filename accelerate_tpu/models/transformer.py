@@ -328,25 +328,31 @@ class MoE(nn.Module):
             # expert-parallel path
             dispatch = "capacity" if ep_live else "ragged"
         if dispatch == "ragged":
-            from ..ops.moe import moe_ragged
+            from ..ops.moe import moe_ragged, moe_ragged_ep
 
             if ep_live:
-                # data-dependent group sizes cannot shard over ep: GSPMD
-                # would all-gather the full expert weights everywhere
-                raise ValueError(
-                    "moe_dispatch='ragged' does not compose with ep_size>1;"
-                    " use 'capacity' (static all-to-all) for expert "
-                    "parallelism, or 'auto' to pick per-mesh"
-                )
-
-            out = moe_ragged(
-                xc.reshape(b * s, h),
-                sel.reshape(b * s, K),
-                weights.reshape(b * s, K),
-                w_gate.astype(dtype),
-                w_up.astype(dtype),
-                w_down.astype(dtype),
-            ).reshape(b, s, h)
+                # expert-parallel ragged: shard-capacity schedule — the
+                # sorted rows' per-shard region runs through a static
+                # window with ragged-packed local experts (ops/moe.py)
+                out = moe_ragged_ep(
+                    xc.reshape(b * s, h),
+                    sel.reshape(b * s, K),
+                    weights.reshape(b * s, K),
+                    w_gate.astype(dtype),
+                    w_up.astype(dtype),
+                    w_down.astype(dtype),
+                    mesh=mesh,
+                    capacity_factor=cfg.moe_capacity_factor,
+                ).reshape(b, s, h)
+            else:
+                out = moe_ragged(
+                    xc.reshape(b * s, h),
+                    sel.reshape(b * s, K),
+                    weights.reshape(b * s, K),
+                    w_gate.astype(dtype),
+                    w_up.astype(dtype),
+                    w_down.astype(dtype),
+                ).reshape(b, s, h)
         elif dispatch == "capacity":
             def experts_fn(buf):  # (E, C, h) -> (E, C, h)
                 hidden = jnp.einsum("ech,ehf->ecf", buf, w_gate.astype(dtype))

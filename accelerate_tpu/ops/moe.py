@@ -81,12 +81,11 @@ def moe_ragged(
     Fully differentiable (ragged_dot has grad rules; sort / gather /
     scatter-add are linear).
 
-    Use on single-chip / data-parallel meshes. With ``ep_size > 1`` the
-    per-expert group sizes are data-dependent, which GSPMD cannot shard
-    over the ep axis — the capacity schedule (static all-to-all shapes)
-    remains the expert-parallel path. (A manual shard_map EP path over
-    ``jax.lax.ragged_all_to_all`` could lift this; the measured capacity
-    ceiling at ep>1 is the documented trade until then.)
+    Use on single-chip / data-parallel meshes. With ``ep_size > 1``
+    the per-expert group sizes are data-dependent, which GSPMD cannot
+    shard over the ep axis — :func:`moe_ragged_ep` (a manual shard_map
+    shard-capacity schedule) is the expert-parallel ragged path, and the
+    per-expert capacity schedule remains the GSPMD-auto alternative.
 
     ``x``: (T, h); ``sel``/``weights``: (T, K); ``w_gate``/``w_up``:
     (E, h, f); ``w_down``: (E, f, h). Returns (T, h).
@@ -109,6 +108,135 @@ def moe_ragged(
     # combine: weighted scatter-add back into token order (sums the K
     # expert contributions per token)
     return jnp.zeros((T, h), out.dtype).at[tok].add(out * w_flat[:, None])
+
+
+def moe_ragged_ep(
+    x: jax.Array,
+    sel: jax.Array,
+    weights: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    mesh,
+    capacity_factor: float = 1.25,
+    axis_name: str = "ep",
+) -> jax.Array:
+    """Expert-parallel grouped-matmul MoE: the ragged schedule under an
+    ``ep``-sharded expert dim (lifts ``moe_ragged``'s single-shard limit).
+
+    Shard-capacity design (static shapes, which per-expert ragged routing
+    cannot give GSPMD): tokens sort by selected expert — identically on
+    every shard — so each ep shard's experts own ONE contiguous region of
+    the sorted (T*K) rows. Each shard processes a fixed-size window of
+    ``C_s = ceil(T*K/ep * capacity_factor)`` rows starting at its
+    region's offset: inside the window, LOCAL experts' rows hit their
+    expert via ``ragged_dot`` with NO per-expert padding; rows past the
+    local region fall into a zero-weight dummy group (free of wrong
+    results, they belong to the next shard's region and are computed
+    there). Combine is a weighted scatter-add + one psum over ep.
+
+    vs the per-expert capacity schedule: padding waste is per-SHARD, not
+    per-expert — drops happen only when a shard's whole expert-group
+    overflows ``capacity_factor`` headroom (much rarer than one hot
+    expert overflowing), and the expert matmuls stay ragged-packed.
+    ``capacity_factor >= ep`` (each shard's window covers all T*K rows)
+    cannot drop and equals the dense oracle exactly.
+
+    Built as a nested shard_map manual over ONLY the ep axis (the same
+    context-mesh pattern as ring attention under pp, with
+    ``check_vma=True`` — its transpose is what makes the backward
+    correct). ``x``: (T, h) global; ``w_*``: (E, h, f)/(E, f, h) with E
+    sharded over ep; returns (T, h).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    ep = mesh.shape[axis_name]
+    T, h = x.shape
+    K = sel.shape[-1]
+    E = w_gate.shape[0]
+    El = E // ep
+    TK = T * K
+    C_s = max(8 * math.ceil(TK * capacity_factor / ep / 8), 8)
+
+    def body(xl, sell, wl, wg, wu, wd):
+        shard = jax.lax.axis_index(axis_name)
+        flat_sel = sell.reshape(TK)
+        order = jnp.argsort(flat_sel)  # stable: ties keep token order
+        tok = jnp.repeat(jnp.arange(T), K)[order]
+        w_flat = wl.reshape(TK)[order]
+        counts = jnp.bincount(flat_sel, length=E).astype(jnp.int32)
+        offsets = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)]
+        )  # (E+1,) exclusive prefix
+        my_first = shard * El
+        off_s = offsets[my_first]
+
+        # static-size window of the sorted rows starting at this shard's
+        # region; pad so the slice never reads out of bounds (padded tok
+        # indices point at row 0 but always land in the dummy group)
+        pad = lambda a: jnp.concatenate(
+            [a, jnp.zeros((C_s,) + a.shape[1:], a.dtype)]
+        )
+        tok_win = jax.lax.dynamic_slice(pad(tok), (off_s,), (C_s,))
+        w_win = jax.lax.dynamic_slice(pad(w_flat), (off_s,), (C_s,))
+        xs = jnp.take(xl, tok_win, axis=0)  # (C_s, h)
+
+        # local group sizes clipped into the window + dummy tail group
+        lo, hi = off_s, off_s + C_s
+        starts = jnp.clip(
+            jax.lax.dynamic_slice(offsets, (my_first,), (El,)), lo, hi
+        )
+        ends = jnp.clip(
+            jax.lax.dynamic_slice(offsets, (my_first + 1,), (El,)), lo, hi
+        )
+        gs = (ends - starts).astype(jnp.int32)
+        gs = jnp.concatenate([gs, (C_s - jnp.sum(gs))[None].astype(jnp.int32)])
+
+        zed = jnp.zeros((1,) + wg.shape[1:], wg.dtype)
+        hidden = jax.nn.silu(
+            jax.lax.ragged_dot(xs, jnp.concatenate([wg, zed]), gs)
+        ) * jax.lax.ragged_dot(xs, jnp.concatenate([wu, zed]), gs)
+        out = jax.lax.ragged_dot(
+            hidden, jnp.concatenate([wd, jnp.zeros((1,) + wd.shape[1:], wd.dtype)]),
+            gs,
+        )  # (C_s, h); dummy-group rows are exact zeros
+
+        contrib = jnp.zeros((T, h), out.dtype).at[tok_win].add(
+            out * w_win[:, None].astype(out.dtype)
+        )
+        return jax.lax.psum(contrib, axis_name)
+
+    # nested-manual aware, same as ops/ring_attention.py
+    sm_mesh = mesh
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        if any("Manual" in str(t) for t in getattr(ctx, "axis_types", ())):
+            sm_mesh = ctx
+    except Exception:  # noqa: BLE001
+        pass
+    from jax import shard_map
+
+    import inspect
+
+    if "axis_names" not in inspect.signature(shard_map).parameters:
+        # full-manual would manualize dp/fsdp too: in_specs P() for the
+        # activations would all-gather the global batch onto every device
+        # (dp-times redundant FLOPs + memory) — refuse, like
+        # parallel/pipeline.py does for the same capability gap
+        raise NotImplementedError(
+            "moe_ragged_ep needs jax shard_map partial-manual mode "
+            "(axis_names), unavailable in this jax version — use "
+            "moe_dispatch='capacity' for expert parallelism"
+        )
+    return shard_map(
+        body,
+        mesh=sm_mesh,
+        in_specs=(P(), P(), P(), P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=P(),
+        check_vma=True,
+        axis_names={axis_name},
+    )(x, sel, weights, w_gate, w_up, w_down)
 
 
 def moe_dispatch_combine(

@@ -536,6 +536,17 @@ def save_hf_checkpoint(
 
     from ..checkpointing import _save_named, flatten_tree, parse_size
 
+    if config.num_experts and getattr(config, "qkv_bias", False):
+        # no HF arch matches "Mixtral experts + Qwen2 qkv biases": a
+        # mixtral-labeled export would make transformers silently DROP
+        # the bias tensors (divergent logits) and the native reload
+        # would error on unconsumed keys. Checked BEFORE any shard is
+        # written — a Mixtral-scale export is hours of I/O and a late
+        # failure would leave orphaned shards on disk.
+        raise ValueError(
+            "no HF model_type represents num_experts>0 with qkv_bias=True; "
+            "export with qkv_bias=False or save a native checkpoint"
+        )
     for name, leaf in flatten_tree(params).items():
         arr = leaf.value if hasattr(leaf, "value") else leaf
         if (
@@ -613,15 +624,6 @@ def save_hf_checkpoint(
         with open(os.path.join(save_directory, "config.json"), "w") as f:
             json.dump(hf_cfg, f, indent=2, sort_keys=True)
         return
-    if config.num_experts and getattr(config, "qkv_bias", False):
-        # no HF arch matches "Mixtral experts + Qwen2 qkv biases": a
-        # mixtral-labeled export would make transformers silently DROP
-        # the bias tensors (divergent logits) and the native reload
-        # would error on unconsumed keys — fail loudly instead
-        raise ValueError(
-            "no HF model_type represents num_experts>0 with qkv_bias=True; "
-            "export with qkv_bias=False or save a native checkpoint"
-        )
     if config.num_experts:
         arch_name, mt = "MixtralForCausalLM", "mixtral"
     elif getattr(config, "qkv_bias", False):
