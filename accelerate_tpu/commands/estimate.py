@@ -71,6 +71,7 @@ def estimate_from_config(preset_or_json: str, dtype: str = "bfloat16",
         "gpt2": TransformerConfig.gpt2,
         "llama3-8b": TransformerConfig.llama3_8b,
         "llama3-70b": TransformerConfig.llama3_70b,
+        "qwen2-7b": TransformerConfig.qwen2_7b,
         "mixtral-8x7b": TransformerConfig.mixtral_8x7b,
     }
     if preset_or_json in presets:

@@ -196,6 +196,20 @@ class TransformerConfig:
         return cls(**kw)
 
     @classmethod
+    def qwen2_7b(cls, **kw) -> "TransformerConfig":
+        """Qwen2-7B shape (the qkv-bias interop family)."""
+        kw.setdefault("vocab_size", 152064)
+        kw.setdefault("hidden_size", 3584)
+        kw.setdefault("intermediate_size", 18944)
+        kw.setdefault("num_layers", 28)
+        kw.setdefault("num_heads", 28)
+        kw.setdefault("num_kv_heads", 4)
+        kw.setdefault("max_seq_len", 32768)
+        kw.setdefault("rope_theta", 1000000.0)
+        kw.setdefault("qkv_bias", True)
+        return cls(**kw)
+
+    @classmethod
     def t5_base(cls, **kw) -> "TransformerConfig":
         """T5-base shape family (reference megatron t5 parser
         utils/megatron_lm.py:1717): 12+12 layers, 768 hidden. SwiGLU/rope
