@@ -84,6 +84,15 @@ class TransformerConfig:
     # _compute_llama3_parameters so HF checkpoints logits-match.
     rope_scaling: Optional[dict] = None
     rms_norm_eps: float = 1e-5
+    # Gemma-family math switches (key layout is Llama's; only the math
+    # differs — utils/hf_interop.py maps model_type "gemma" onto these):
+    # RMSNorm multiplies by (1 + scale) with zero-init scales,
+    norm_offset: bool = False
+    # the MLP gate activation ("silu" = Llama/Mixtral, "gelu_tanh" =
+    # Gemma's gelu_pytorch_tanh),
+    mlp_activation: str = "silu"
+    # and embedding outputs scale by sqrt(hidden_size).
+    embed_scale: bool = False
     tie_embeddings: bool = False
     # False -> bidirectional self-attention (BERT-family encoders)
     causal: bool = True
@@ -117,6 +126,11 @@ class TransformerConfig:
         if self.arch not in ("llama", "gpt2"):
             raise ValueError(
                 f"unknown arch {self.arch!r}; supported: llama, gpt2"
+            )
+        if self.mlp_activation not in ("silu", "gelu_tanh"):
+            raise ValueError(
+                f"unknown mlp_activation {self.mlp_activation!r}; "
+                "supported: silu, gelu_tanh"
             )
         # an unsupported/underspecified rope_scaling silently ignored (or
         # crashing only at trace time) would pass every weight check and

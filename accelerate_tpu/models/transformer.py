@@ -49,16 +49,25 @@ class RMSNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        eps = self.config.rms_norm_eps
+        cfg = self.config
+        eps = cfg.rms_norm_eps
+        # Gemma stores zero-centered scales and multiplies by (1 + w);
+        # a zeros init keeps a fresh norm at identity either way
+        init = (
+            nn.initializers.zeros_init()
+            if cfg.norm_offset
+            else nn.initializers.ones_init()
+        )
         scale = self.param(
             "scale",
-            nn.with_partitioning(nn.initializers.ones_init(), ("norm",)),
+            nn.with_partitioning(init, ("norm",)),
             (x.shape[-1],),
             jnp.float32,
         )
         var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
         y = x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
-        return (y * scale).astype(x.dtype)
+        mult = (1.0 + scale) if cfg.norm_offset else scale
+        return (y * mult).astype(x.dtype)
 
 
 def _scale_rope_freqs(freqs: jax.Array, scaling: Optional[dict]) -> jax.Array:
@@ -258,8 +267,13 @@ class MLP(nn.Module):
             proj("up_proj", cfg.intermediate_size, ("embed", "mlp"))(x),
             "mlp_up_out",
         )
+        act = (
+            nn.silu
+            if cfg.mlp_activation == "silu"
+            else lambda z: nn.gelu(z, approximate=True)  # Gemma gelu_tanh
+        )
         return proj("down_proj", cfg.hidden_size, ("mlp", "embed"))(
-            nn.silu(gate) * up
+            act(gate) * up
         )
 
 
@@ -526,7 +540,10 @@ class CausalLM(nn.Module):
         from ..parallel.sharding import constrain_activations
 
         embed = _make_embed(cfg, dtype)
-        x = constrain_activations(embed(input_ids))
+        x = embed(input_ids)
+        if cfg.embed_scale:  # Gemma scales embeddings by sqrt(hidden)
+            x = x * jnp.asarray(np.sqrt(cfg.hidden_size), x.dtype)
+        x = constrain_activations(x)
         x = _apply_layer_stack(cfg, x, positions, mask, decode=decode)
         x = constrain_activations(RMSNorm(cfg, name="final_norm")(x))
         # logits matmul stays in the compute dtype (bf16 on the MXU — fp32

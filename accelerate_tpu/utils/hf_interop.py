@@ -29,8 +29,10 @@ interchange without any permutation of head dims.
 
 Architectures covered: the Llama family (Llama-2/3/3.1+ incl. GQA,
 llama3/linear rope scaling, tied or untied heads), Qwen2 (the Llama
-layout plus q/k/v biases — ``TransformerConfig.qkv_bias``), Mixtral-style
-MoE — the BASELINE.md targets (Llama-3-8B FSDP, Mixtral 8x7B EP,
+layout plus q/k/v biases — ``TransformerConfig.qkv_bias``), Gemma v1
+(offset RMSNorm / tanh-GELU gate / scaled embeddings —
+``norm_offset``/``mlp_activation``/``embed_scale``; Gemma-2/3 rejected),
+Mixtral-style MoE — the BASELINE.md targets (Llama-3-8B FSDP, Mixtral 8x7B EP,
 Llama-3-70B device_map="auto") — and classic GPT-2 via the faithful
 :class:`~...models.gpt2.GPT2LM` (learned positions, LayerNorm, biases,
 fused c_attn; HF Conv1D already stores ``(in, out)`` so that mapping has
@@ -182,14 +184,25 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
             "Qwen2 checkpoints with use_sliding_window=true are not "
             "supported by the native attention"
         )
-    if model_type not in ("llama", "mixtral", "qwen2"):
-        # Gemma/... share the model.layers.* key convention and every
+    if model_type in ("gemma2", "gemma3", "gemma3_text"):
+        # Gemma-2/3 add attention/final-logit soft-capping, pre+post
+        # norms per block and sliding-window layers — math the native
+        # model does not implement; every tensor of the shared keys
+        # would load and logits would silently diverge
+        raise ValueError(
+            f"HF model_type {model_type!r} is not supported: Gemma-2/3 "
+            "soft-capping/post-norms/sliding-window are not implemented "
+            "(Gemma v1 loads via model_type 'gemma')"
+        )
+    if model_type not in ("llama", "mixtral", "qwen2", "gemma"):
+        # Phi/... share the model.layers.* key convention and every
         # config field this mapping reads, but differ in parameters the
-        # plan would silently drop (offset norms, soft-capping) — loading
-        # them would succeed and generate garbage.
+        # plan would silently drop — loading them would succeed and
+        # generate garbage.
         raise ValueError(
             f"HF model_type {model_type!r} is not supported by the "
-            "parameter mappings; supported: llama, mixtral, qwen2, gpt2"
+            "parameter mappings; supported: llama, mixtral, qwen2, gemma, "
+            "gpt2"
         )
     kw = dict(
         vocab_size=hf["vocab_size"],
@@ -207,6 +220,23 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
         # arch, not a config.json field)
         qkv_bias=model_type == "qwen2",
     )
+    if model_type == "gemma":
+        act = hf.get("hidden_activation") or hf.get("hidden_act")
+        if act not in (None, "gelu", "gelu_pytorch_tanh"):
+            raise ValueError(
+                f"Gemma hidden_activation {act!r} is not the tanh GELU "
+                "the native model implements"
+            )
+        # Gemma v1: Llama's key layout, different math — offset RMSNorm,
+        # tanh-GELU gate, sqrt(h)-scaled embeddings, always-tied heads,
+        # and an explicit head_dim decoupled from hidden/num_heads
+        kw.update(
+            norm_offset=True,
+            mlp_activation="gelu_tanh",
+            embed_scale=True,
+            tie_embeddings=True,
+            head_dim=hf.get("head_dim"),
+        )
     if hf.get("num_local_experts"):
         kw["num_experts"] = hf["num_local_experts"]
         kw["num_experts_per_tok"] = hf.get("num_experts_per_tok", 2)
@@ -507,6 +537,50 @@ def _hf_emission_sizes(params: Any, config) -> list[int]:
     return sizes
 
 
+def _export_arch(config) -> tuple[str, str]:
+    """The HF (architecture, model_type) an exported config maps to —
+    rejecting any switch combination NO HF model_type represents. A
+    mislabeled export is the silent-divergence failure mode this module
+    exists to prevent: transformers would load every matching tensor,
+    drop/ignore the rest (qkv biases under Gemma/Mixtral labels), and the
+    round-trip would re-infer different math (partial Gemma switch sets,
+    Mixtral labels carrying none of the offset-norm/gelu/embed-scale
+    semantics)."""
+    gemma_flags = (
+        getattr(config, "norm_offset", False),
+        getattr(config, "mlp_activation", "silu") == "gelu_tanh",
+        getattr(config, "embed_scale", False),
+    )
+    is_gemma = all(gemma_flags)
+    if any(gemma_flags) and not is_gemma:
+        raise ValueError(
+            "partial Gemma switch set (norm_offset/mlp_activation="
+            "'gelu_tanh'/embed_scale must all be on or all off) matches "
+            "no HF model_type; save a native checkpoint instead"
+        )
+    qkv = getattr(config, "qkv_bias", False)
+    moe = bool(config.num_experts)
+    if sum((is_gemma, qkv, moe)) > 1:
+        raise ValueError(
+            "no HF model_type represents this switch combination "
+            f"(gemma-math={is_gemma}, qkv_bias={qkv}, moe={moe}); "
+            "save a native checkpoint instead"
+        )
+    if is_gemma and not config.tie_embeddings:
+        raise ValueError(
+            "Gemma checkpoints are always tied; an untied lm_head would "
+            "be silently dropped by transformers — tie_embeddings=True "
+            "or save a native checkpoint"
+        )
+    if moe:
+        return "MixtralForCausalLM", "mixtral"
+    if is_gemma:
+        return "GemmaForCausalLM", "gemma"
+    if qkv:
+        return "Qwen2ForCausalLM", "qwen2"
+    return "LlamaForCausalLM", "llama"
+
+
 def save_hf_checkpoint(
     params: Any,
     config,
@@ -536,17 +610,9 @@ def save_hf_checkpoint(
 
     from ..checkpointing import _save_named, flatten_tree, parse_size
 
-    if config.num_experts and getattr(config, "qkv_bias", False):
-        # no HF arch matches "Mixtral experts + Qwen2 qkv biases": a
-        # mixtral-labeled export would make transformers silently DROP
-        # the bias tensors (divergent logits) and the native reload
-        # would error on unconsumed keys. Checked BEFORE any shard is
-        # written — a Mixtral-scale export is hours of I/O and a late
-        # failure would leave orphaned shards on disk.
-        raise ValueError(
-            "no HF model_type represents num_experts>0 with qkv_bias=True; "
-            "export with qkv_bias=False or save a native checkpoint"
-        )
+    # checked BEFORE any shard is written — a big-model export is hours
+    # of I/O and a late failure would leave orphaned shards on disk
+    _export_arch(config)
     for name, leaf in flatten_tree(params).items():
         arr = leaf.value if hasattr(leaf, "value") else leaf
         if (
@@ -624,12 +690,7 @@ def save_hf_checkpoint(
         with open(os.path.join(save_directory, "config.json"), "w") as f:
             json.dump(hf_cfg, f, indent=2, sort_keys=True)
         return
-    if config.num_experts:
-        arch_name, mt = "MixtralForCausalLM", "mixtral"
-    elif getattr(config, "qkv_bias", False):
-        arch_name, mt = "Qwen2ForCausalLM", "qwen2"
-    else:
-        arch_name, mt = "LlamaForCausalLM", "llama"
+    arch_name, mt = _export_arch(config)
     hf_cfg = {
         "architectures": [arch_name],
         "model_type": mt,
@@ -646,6 +707,9 @@ def save_hf_checkpoint(
     }
     if config.rope_scaling:
         hf_cfg["rope_scaling"] = config.rope_scaling
+    if mt == "gemma":
+        hf_cfg["head_dim"] = config.head_dim
+        hf_cfg["hidden_activation"] = "gelu_pytorch_tanh"
     if config.num_experts:
         hf_cfg["num_local_experts"] = config.num_experts
         hf_cfg["num_experts_per_tok"] = config.num_experts_per_tok

@@ -245,10 +245,11 @@ def test_lookalike_arch_rejected(tmp_path):
     config = infer_config_from_hf(path, attention_impl="xla")
 
     # 1) unknown model_type in config.json -> infer_config_from_hf raises
-    # (qwen2 moved to SUPPORTED in round 4; gemma stays a lookalike)
+    # (qwen2 AND gemma moved to SUPPORTED in round 4; phi3 stays a
+    # lookalike — fused qkv_proj the mapping would drop)
     cfg_path = os.path.join(path, "config.json")
     hf_cfg = json.load(open(cfg_path))
-    hf_cfg["model_type"] = "gemma"
+    hf_cfg["model_type"] = "phi3"
     json.dump(hf_cfg, open(cfg_path, "w"))
     with pytest.raises(ValueError, match="model_type"):
         infer_config_from_hf(path)
@@ -615,6 +616,32 @@ def test_qwen2_sliding_window_rejected(tmp_path):
         infer_config_from_hf(path)
 
 
+def test_unrepresentable_export_combos_rejected():
+    """Switch combinations no HF model_type represents must fail at
+    export-dispatch time, before any shard is written (code-review r4):
+    partial Gemma switch sets, gemma+qkv_bias, moe+gemma, untied gemma."""
+    from accelerate_tpu.utils.hf_interop import _export_arch
+
+    ok = TransformerConfig(**_TINY, attention_impl="xla")
+    assert _export_arch(ok) == ("LlamaForCausalLM", "llama")
+    gemma = TransformerConfig(
+        **_TINY, attention_impl="xla", norm_offset=True,
+        mlp_activation="gelu_tanh", embed_scale=True, tie_embeddings=True,
+    )
+    assert _export_arch(gemma) == ("GemmaForCausalLM", "gemma")
+    import dataclasses
+
+    with pytest.raises(ValueError, match="partial Gemma"):
+        _export_arch(dataclasses.replace(gemma, embed_scale=False))
+    with pytest.raises(ValueError, match="combination"):
+        _export_arch(dataclasses.replace(gemma, qkv_bias=True))
+    with pytest.raises(ValueError, match="combination"):
+        _export_arch(dataclasses.replace(
+            gemma, num_experts=4, moe_dispatch="dense"))
+    with pytest.raises(ValueError, match="tied"):
+        _export_arch(dataclasses.replace(gemma, tie_embeddings=False))
+
+
 def test_moe_with_qkv_bias_export_rejected(tmp_path):
     """num_experts>0 + qkv_bias=True matches no HF model_type; a
     mixtral-labeled export would silently drop the biases in transformers
@@ -629,5 +656,59 @@ def test_moe_with_qkv_bias_export_rejected(tmp_path):
     params = model.init(
         jax.random.PRNGKey(14), jnp.zeros((1, 8), jnp.int32)
     )["params"]
-    with pytest.raises(ValueError, match="qkv_bias"):
+    with pytest.raises(ValueError, match="combination"):
         save_hf_checkpoint(params, config, str(tmp_path / "bad"))
+
+
+def test_gemma_checkpoint_logits_match_torch(tmp_path):
+    """Gemma v1 (Llama key layout; offset RMSNorm, tanh-GELU gate,
+    sqrt(h)-scaled embeddings, explicit head_dim, tied heads) loads with
+    logits matching transformers, and exports back as model_type gemma."""
+    cfg = transformers.GemmaConfig(
+        vocab_size=_TINY["vocab_size"],
+        hidden_size=_TINY["hidden_size"],
+        intermediate_size=_TINY["intermediate_size"],
+        num_hidden_layers=_TINY["num_layers"],
+        num_attention_heads=_TINY["num_heads"],
+        num_key_value_heads=_TINY["num_kv_heads"],
+        head_dim=32,  # DECOUPLED: != hidden/num_heads (= 16) like real Gemma
+        max_position_embeddings=_TINY["max_seq_len"],
+        rope_theta=10000.0,
+        rms_norm_eps=_TINY["rms_norm_eps"],
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(15)
+    hf_model = transformers.GemmaForCausalLM(cfg).eval()
+    path = str(tmp_path / "hf_gemma")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    config = infer_config_from_hf(path, attention_impl="xla")
+    assert config.norm_offset and config.embed_scale
+    assert config.mlp_activation == "gelu_tanh" and config.tie_embeddings
+    assert config.head_dim == 32
+    params = load_checkpoint_and_dispatch(
+        _abstract(config), path, device_map={"": "cpu"}
+    )
+    ours = _native_logits(config, params, _IDS)
+    theirs = _torch_logits(hf_model, _IDS)
+    np.testing.assert_allclose(ours, theirs, rtol=3e-4, atol=3e-4)
+
+    out = str(tmp_path / "gemma_export")
+    save_hf_checkpoint(params, config, out)
+    assert json.load(open(os.path.join(out, "config.json")))["model_type"] == "gemma"
+    hf2 = transformers.GemmaForCausalLM.from_pretrained(out).eval()
+    np.testing.assert_allclose(
+        _torch_logits(hf2, _IDS), theirs, rtol=3e-4, atol=3e-4
+    )
+
+
+def test_gemma2_rejected(tmp_path):
+    """Gemma-2 soft-capping/post-norms are not implemented — model_type
+    gemma2 must be rejected at config time, before any tensor loads."""
+    _, path = _save_hf_llama(tmp_path)
+    cfg_path = os.path.join(path, "config.json")
+    hf_cfg = json.load(open(cfg_path))
+    hf_cfg["model_type"] = "gemma2"
+    json.dump(hf_cfg, open(cfg_path, "w"))
+    with pytest.raises(ValueError, match="gemma2"):
+        infer_config_from_hf(path)
