@@ -208,13 +208,10 @@ def moe_ragged_ep(
         return jax.lax.psum(contrib, axis_name)
 
     # nested-manual aware, same as ops/ring_attention.py
-    sm_mesh = mesh
-    try:
-        ctx = jax.sharding.get_abstract_mesh()
-        if any("Manual" in str(t) for t in getattr(ctx, "axis_types", ())):
-            sm_mesh = ctx
-    except Exception:  # noqa: BLE001
-        pass
+    from ..utils.operations import nested_manual_mesh
+
+    ctx = nested_manual_mesh()
+    sm_mesh = ctx if ctx is not None else mesh
     from jax import shard_map
 
     import inspect

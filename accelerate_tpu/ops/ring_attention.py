@@ -203,19 +203,12 @@ def ring_attention(
     # replication accounting and produces silently wrong cotangents
     # (verified by the pp x sp equivalence test; loss matches, grads
     # diverge ~1e3 without it).
-    sm_mesh = mesh
-    nested_manual = False
-    try:
-        ctx = jax.sharding.get_abstract_mesh()
-        nested_manual = any(
-            "Manual" in str(t) for t in getattr(ctx, "axis_types", ())
-        )
-        if nested_manual:
-            sm_mesh = ctx
-    except Exception:  # noqa: BLE001 — older jax without abstract meshes
-        pass
+    from ..utils.operations import nested_manual_mesh
+
+    ctx = nested_manual_mesh()
+    sm_mesh = ctx if ctx is not None else mesh
 
     return shard_map(
         body, mesh=sm_mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=nested_manual,
+        check_vma=ctx is not None,
     )(q, k, v)

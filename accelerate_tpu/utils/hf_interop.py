@@ -102,12 +102,26 @@ def list_checkpoint_keys(checkpoint: str) -> list[str]:
     return sorted(keys)
 
 
+# The canonical hub GPT-2 checkpoints (gpt2, gpt2-medium, ...) store the
+# BASE model's keys unprefixed (``wte.weight``, ``h.0.attn.c_attn.weight``);
+# transformers re-prefixes them via ``base_model_prefix`` at load. A local
+# ``GPT2LMHeadModel.save_pretrained`` writes the prefixed layout. Both are
+# real-world GPT-2 checkpoints; both must detect and load.
+def _is_unprefixed_gpt2_key(k: str) -> bool:
+    return (
+        k in ("wte.weight", "wpe.weight")
+        or k.startswith("ln_f.")
+        or re.match(r"h\.\d+\.", k) is not None
+    )
+
+
 def is_hf_checkpoint(checkpoint: str) -> bool:
     """True when the checkpoint uses HF transformers key conventions
     (``model.embed_tokens.weight`` / ``model.layers.{i}...`` for the
     Llama family, ``transformer.wte.weight`` / ``transformer.h.{i}...``
-    for GPT-2) rather than this package's native ``//``-joined pytree
-    paths."""
+    — or the hub's unprefixed base-model layout ``wte.weight`` /
+    ``h.{i}...`` — for GPT-2) rather than this package's native
+    ``//``-joined pytree paths."""
     try:
         keys = list_checkpoint_keys(checkpoint)
     except (FileNotFoundError, OSError):
@@ -117,6 +131,7 @@ def is_hf_checkpoint(checkpoint: str) -> bool:
         or k.startswith("model.layers.")
         or k == "transformer.wte.weight"
         or k.startswith("transformer.h.")
+        or _is_unprefixed_gpt2_key(k)
         for k in keys
     )
 
@@ -422,6 +437,20 @@ def hf_native_reader(
             with safe_open(path, framework="numpy") as f:
                 for k in f.keys():
                     key_to_file[k] = path
+    if getattr(config, "arch", "llama") == "gpt2":
+        # hub gpt2/gpt2-medium/... store the BASE model's keys unprefixed
+        # (wte.weight, h.0.attn.c_attn.weight — transformers re-prefixes
+        # via base_model_prefix at load); normalize to the prefixed layout
+        # the plan emits so both real-world layouts load identically
+        stored_name = {
+            (f"transformer.{k}" if _is_unprefixed_gpt2_key(k) else k): k
+            for k in key_to_file
+        }
+        key_to_file = {
+            new: key_to_file[old] for new, old in stored_name.items()
+        }
+    else:
+        stored_name = {}
     consumed: set[str] = set()
 
     def read_hf(key: str) -> np.ndarray:
@@ -432,7 +461,7 @@ def hf_native_reader(
                 f"(available e.g. {sorted(key_to_file)[:4]}...)"
             )
         with safe_open(key_to_file[key], framework="numpy") as f:
-            return f.get_tensor(key)
+            return f.get_tensor(stored_name.get(key, key))
 
     def maybe_t(a: np.ndarray, transpose: bool) -> np.ndarray:
         return a.T if transpose and a.ndim == 2 else a

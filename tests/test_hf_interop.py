@@ -489,6 +489,51 @@ def test_gpt2_checkpoint_logits_match_torch(tmp_path):
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
 
+def test_gpt2_unprefixed_hub_layout_loads(tmp_path):
+    """The canonical hub gpt2/gpt2-medium/... safetensors store the BASE
+    model's keys unprefixed (``wte.weight``, ``h.0.attn.c_attn.weight``) —
+    transformers re-prefixes them via ``base_model_prefix`` at load. A
+    checkpoint rewritten to that layout must detect as HF and load with
+    identical logits (ADVICE r4 medium)."""
+    from safetensors import safe_open
+    from safetensors.numpy import save_file
+
+    from accelerate_tpu.models import causal_model_for
+
+    hf_model, path = _save_hf_gpt2(tmp_path)
+    # rewrite to the hub's unprefixed base-model layout
+    src = os.path.join(path, "model.safetensors")
+    with safe_open(src, framework="numpy") as f:
+        tensors = {
+            k.removeprefix("transformer."): f.get_tensor(k) for k in f.keys()
+        }
+    assert any(k.startswith("h.0.") for k in tensors), "rewrite had no effect"
+    unpref = str(tmp_path / "hf_gpt2_unprefixed")
+    os.makedirs(unpref)
+    save_file(tensors, os.path.join(unpref, "model.safetensors"))
+    with open(os.path.join(path, "config.json")) as f:
+        cfg_json = f.read()
+    with open(os.path.join(unpref, "config.json"), "w") as f:
+        f.write(cfg_json)
+
+    assert is_hf_checkpoint(unpref)
+    config = infer_config_from_hf(unpref, attention_impl="xla")
+    model = causal_model_for(config)
+    abstract = init_empty_weights(
+        lambda: model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+            "params"
+        ]
+    )
+    params = load_checkpoint_and_dispatch(
+        abstract, unpref, device_map={"": "cpu"}, config=config,
+    )
+    ours = np.asarray(
+        model.apply({"params": params}, jnp.asarray(_IDS)), dtype=np.float32
+    )
+    theirs = _torch_logits(hf_model, _IDS)
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
 def test_gpt2_generate_matches_torch_greedy(tmp_path):
     """The GPT-2 KV-cache decode path (wpe position counter + per-layer
     cache) reproduces transformers' greedy generation."""
