@@ -568,14 +568,6 @@ class Accelerator:
                 "use gradient_accumulation_steps=1"
             )
         policy = self.state.mixed_precision_policy
-        if policy.uses_loss_scaling:
-            # fp16 would need the loss scale threaded through the 1F1B
-            # schedule (scaled cotangents + finite-skip) — unimplemented;
-            # refuse rather than silently committing overflowed grads
-            raise NotImplementedError(
-                "unified_pipeline_step does not support fp16 loss scaling; "
-                "use mixed_precision='bf16' (TPU-native) or 'no'"
-            )
         mesh = self.mesh
         num_micro = self.state.parallelism_plugin.num_micro_batches
         opt_transform = optimizer.optimizer
@@ -590,14 +582,27 @@ class Accelerator:
 
         def _step(carry, x, targets):
             params, opt_state = carry["params"], carry["opt_state"]
+            ls = carry.get("loss_scale")
             compute_params = _cast_floating(params, policy.compute_dtype)
             compute_x = _cast_floating(x, policy.compute_dtype)
             compute_targets = _cast_floating(targets, policy.compute_dtype)
+
+            def scaled_loss_fn(y, t):
+                # fp16: scaling each microbatch loss scales the cotangent
+                # jax.grad seeds at the LAST stage per microbatch — the
+                # whole backward schedule (ppermute'd stage cotangents
+                # included) runs scaled, exactly the GradScaler contract
+                # (reference optimizer.py:153-168 via Megatron's scaler)
+                return scale_loss(loss_fn(y, t).astype(jnp.float32), ls)
+
             loss, grads = pipeline_train_step(
-                block_fn, loss_fn, compute_params, compute_x, compute_targets,
-                mesh=mesh, num_micro_batches=num_micro,
+                block_fn, scaled_loss_fn, compute_params, compute_x,
+                compute_targets, mesh=mesh, num_micro_batches=num_micro,
             )
             grads = _cast_floating(grads, jnp.float32)
+            # unscale + overflow check + GradScaler bookkeeping (identical
+            # semantics to unified_step's sync boundary)
+            grads, finite, new_ls = unscale_and_check(grads, ls, policy)
             gnorm = optax.global_norm(grads)
             if max_grad_norm is not None:
                 scale_c = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
@@ -608,17 +613,34 @@ class Accelerator:
             new_params = optax.apply_updates(params, updates)
             new_params = _pin_to_shardings(new_params, self._param_shardings)
             new_opt_state = _pin_to_shardings(new_opt_state, _opt_shardings())
+            if ls is not None:
+                # overflow: hold params/opt-state (GradScaler skip), halve
+                # the scale via new_ls
+                new_params = jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o), new_params, params
+                )
+                new_opt_state = jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o), new_opt_state,
+                    opt_state,
+                )
             new_carry = {
                 **carry,
                 "params": new_params,
                 "opt_state": new_opt_state,
                 "opt_step": carry["opt_step"] + 1,
             }
+            if ls is not None:
+                new_carry["loss_scale"] = new_ls
+            # the schedule averaged SCALED microbatch losses; report the
+            # user-scale loss
+            loss = loss.astype(jnp.float32)
+            if ls is not None:
+                loss = loss / ls.scale
             metrics = {
-                "loss": loss.astype(jnp.float32),
+                "loss": loss,
                 "grad_norm": gnorm,
                 # parity with unified_step's metric surface
-                "grads_finite": jnp.isfinite(gnorm),
+                "grads_finite": finite if ls is not None else jnp.isfinite(gnorm),
                 "is_sync_step": jnp.asarray(True),
             }
             return new_carry, metrics

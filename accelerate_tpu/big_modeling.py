@@ -442,7 +442,17 @@ def dispatch_params(
             else:
                 placed[name] = arr
         else:
-            placed[name] = jax.device_put(leaf, devices[int(target)])
+            dev = devices[int(target)]
+            if _is_host_resident(leaf):
+                # a cpu-tier leaf moving back to HBM: device_put(x, Device)
+                # refuses to change the memory space ("Memory kind
+                # mismatch") — same explicit-sharding move as
+                # materialize_offloaded
+                placed[name] = jax.device_put(
+                    leaf, _device_memory_sharding(dev)
+                )
+            else:
+                placed[name] = jax.device_put(leaf, dev)
     if offload_index:
         from .utils.offload import OffloadedWeightsLoader, save_offload_index
 

@@ -96,17 +96,27 @@ class TransformerConfig:
     tie_embeddings: bool = False
     # False -> bidirectional self-attention (BERT-family encoders)
     causal: bool = True
+    # sliding-window attention band (Mistral / sliding Qwen2): each query
+    # sees at most the last `sliding_window` keys, self included — HF
+    # semantics (kv_idx > q_idx - sliding_window AND causal). Applies to
+    # EVERY layer (per-layer mixes are rejected by utils/hf_interop.py —
+    # the nn.scan layout compiles one homogeneous layer body). xla and
+    # flash attention honor it (flash skips below-band kv blocks: work
+    # scales with S*window); ring attention rejects it.
+    sliding_window: Optional[int] = None
     attention_impl: Optional[str] = None  # None=auto | xla | flash | ring
     # MoE (Mixtral family); 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
-    # "auto" (default): "ragged" unless the live mesh has ep_size>1, then
-    # "capacity" (the battle-tested ep path). "ragged": grouped-matmul
-    # dispatch (jax.lax.ragged_dot) — exact math at ep==1 (no padding, no
-    # drops), measured FASTER than capacity at bench shapes (ops/moe.py
-    # docstring numbers); under ep>1 it runs the shard-capacity EP
-    # schedule (ops/moe.moe_ragged_ep — ragged-packed local experts,
-    # per-SHARD headroom, drops only on whole-shard overflow).
+    # "auto" (default): "ragged" at every ep (falls back to "capacity"
+    # only on jax versions without partial-manual shard_map). "ragged":
+    # grouped-matmul dispatch (jax.lax.ragged_dot) — exact math at ep==1
+    # (no padding, no drops), measured FASTER than capacity at bench
+    # shapes (ops/moe.py docstring numbers); under ep>1 it runs the
+    # shard-capacity EP schedule (ops/moe.moe_ragged_ep — ragged-packed
+    # local experts, per-SHARD headroom: at equal capacity_factor it
+    # drops 3-10x fewer tokens and moves ~2x fewer collective bytes than
+    # "capacity", measured numbers in moe_ragged_ep's docstring).
     # "capacity": GShard-style static-shape dispatch — FLOPs scale with
     # K*capacity_factor, overflow tokens drop per expert. "dense": every
     # expert sees every token (the exact-math test oracle, O(E) FLOPs)
@@ -136,6 +146,23 @@ class TransformerConfig:
         # crashing only at trace time) would pass every weight check and
         # still diverge from the source model
         validate_rope_scaling(self.rope_scaling)
+        if self.sliding_window is not None:
+            if self.sliding_window <= 0:
+                raise ValueError(
+                    f"sliding_window must be positive, got {self.sliding_window}"
+                )
+            if not self.causal:
+                raise ValueError(
+                    "sliding_window requires causal attention (the band is "
+                    "a causal-mask refinement)"
+                )
+            if self.attention_impl == "ring":
+                raise ValueError(
+                    "sliding_window is not supported by ring attention — "
+                    "use attention_impl 'flash'/'xla'/None (flash's "
+                    "band-skip already bounds work and memory at "
+                    "window << seq)"
+                )
         if self.num_kv_heads is None:
             self.num_kv_heads = self.num_heads
         if self.head_dim is None:

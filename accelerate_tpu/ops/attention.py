@@ -23,13 +23,22 @@ import jax
 import jax.numpy as jnp
 
 
-def make_causal_mask(q_len: int, kv_len: int, dtype=jnp.bool_) -> jax.Array:
+def make_causal_mask(
+    q_len: int, kv_len: int, dtype=jnp.bool_, window: Optional[int] = None
+) -> jax.Array:
     """Lower-triangular (q_len, kv_len) mask aligned at the end (supports
-    decode where q_len < kv_len)."""
+    decode where q_len < kv_len). ``window``: sliding-window band — query
+    row r additionally sees only the last ``window`` keys (col > r -
+    window, self included), the HF semantics
+    (transformers masking_utils.sliding_window_overlay: ``kv_idx > q_idx -
+    sliding_window`` AND causal)."""
     offset = kv_len - q_len
     rows = jnp.arange(q_len)[:, None]
     cols = jnp.arange(kv_len)[None, :]
-    return (cols <= rows + offset).astype(dtype)
+    keep = cols <= rows + offset
+    if window is not None:
+        keep = jnp.logical_and(keep, cols > rows + offset - window)
+    return keep.astype(dtype)
 
 
 def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
@@ -57,12 +66,17 @@ def xla_attention(
     scale: Optional[float] = None,
     causal: bool = False,
     kv_lengths: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Reference-path attention, shapes (B, S, H, D) / kv (B, Skv, Hkv, D).
 
     fp32 softmax regardless of input dtype (bf16-safe), GQA via kv head
     repetition (broadcast, not materialized by XLA after fusion).
+    ``window`` (requires ``causal``): the Mistral/Qwen2 sliding-window
+    band — each query sees at most the last ``window`` keys.
     """
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
     orig_dtype = q.dtype
     n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)
@@ -73,7 +87,7 @@ def xla_attention(
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
     if causal:
-        cmask = make_causal_mask(q.shape[1], k.shape[1])
+        cmask = make_causal_mask(q.shape[1], k.shape[1], window=window)
         logits = jnp.where(cmask[None, None, :, :], logits, jnp.finfo(jnp.float32).min)
     if kv_lengths is not None:
         mask = (
@@ -115,12 +129,20 @@ def dot_product_attention(
     causal: bool = False,
     kv_lengths: Optional[jax.Array] = None,
     implementation: Optional[str] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Attention entry point, shapes (batch, seq, heads, head_dim).
 
     ``kv_lengths``: (B,) valid-prefix key lengths — the structured form of
     a right-padding key mask (HF tokenizer convention). Flash and xla both
     honor it; arbitrary (non-prefix) masks take the xla path.
+
+    ``window``: causal sliding-window band (Mistral / sliding Qwen2).
+    Supported by the xla and flash paths (the flash kernel additionally
+    SKIPS kv blocks entirely below the band — work scales with
+    S*window, not S^2); ring attention rejects it (a band crossing ring
+    shards would need per-hop bounds — use flash/xla, which at
+    window << S is the memory-frugal regime anyway).
 
     ``implementation``: None (auto) | "xla" | "flash" | "ring".
     Auto picks flash on TPU backends for causal or bidirectional
@@ -141,7 +163,7 @@ def dot_product_attention(
     if implementation == "xla":
         return xla_attention(
             q, k, v, mask=mask, bias=bias, scale=scale, causal=causal,
-            kv_lengths=kv_lengths,
+            kv_lengths=kv_lengths, window=window,
         )
     if implementation == "flash":
         from .flash_attention import flash_attention
@@ -153,12 +175,19 @@ def dot_product_attention(
                 "arbitrary masks"
             )
         return flash_attention(
-            q, k, v, scale=scale, causal=causal, kv_lengths=kv_lengths
+            q, k, v, scale=scale, causal=causal, kv_lengths=kv_lengths,
+            window=window,
         )
     if implementation == "ring":
         from .ring_attention import ring_attention
 
         if mask is not None or bias is not None or kv_lengths is not None:
             raise ValueError("ring attention supports no custom mask/bias")
+        if window is not None:
+            raise ValueError(
+                "ring attention does not support sliding windows — use "
+                "implementation='flash' or 'xla' (at window << seq the "
+                "flash band-skip already bounds memory and work)"
+            )
         return ring_attention(q, k, v, scale=scale, causal=causal)
     raise ValueError(f"unknown attention implementation {implementation!r}")

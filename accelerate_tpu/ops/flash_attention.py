@@ -58,22 +58,37 @@ def fit_block(seq: int, preferred: int):
 NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN from inf-inf
 
 
-def _causal_mask_block(iq, ik, bq, bk, offset):
+def _causal_mask_block(iq, ik, bq, bk, offset, window=None):
     """Boolean (bq, bk) mask for the (iq, ik) block pair: True = attend.
     ``offset = kv_len - q_len`` end-aligns the diagonal (decode: a short
     query block attends to the whole preceding kv context), matching
-    ops.attention.make_causal_mask."""
+    ops.attention.make_causal_mask. ``window`` adds the sliding-window
+    lower bound (col > row + offset - window, HF band semantics)."""
     rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return cols <= rows + offset
+    keep = cols <= rows + offset
+    if window is not None:
+        keep = jnp.logical_and(keep, cols > rows + offset - window)
+    return keep
 
 
-def _block_visible(iq, ik, bq, bk, causal: bool, offset: int = 0, kvlen=None):
+def _block_visible(iq, ik, bq, bk, causal: bool, offset: int = 0, kvlen=None,
+                   window=None):
     """Whether block pair (iq, ik) contains any unmasked entry. ``kvlen``
     (traced scalar, padding mode) additionally skips kv blocks that sit
     entirely in the padded tail — heavily padded batches do
-    proportionally less work, the flash analog of ragged attention."""
+    proportionally less work, the flash analog of ragged attention.
+    ``window`` skips kv blocks entirely BELOW the sliding band (max col
+    of the block <= min row's lower bound): with it, per-query-block work
+    is O(window), the block-skip machinery the banded mask rides on."""
     vis = jnp.asarray(True) if not causal else ik * bk <= iq * bq + (bq - 1) + offset
+    if window is not None:
+        # rows of this q block see cols in (iq*bq + offset - window,
+        # iq*bq + bq - 1 + offset]; the block is dead when its last col
+        # cannot exceed the smallest row's lower bound
+        vis = jnp.logical_and(
+            vis, (ik + 1) * bk - 1 > iq * bq + offset - window
+        )
     if kvlen is not None:
         vis = jnp.logical_and(vis, ik * bk < kvlen)
     return vis
@@ -85,15 +100,23 @@ def _apply_kv_padding(s, ik, bq, bk, kvlen):
     return jnp.where(cols < kvlen, s, NEG_INF)
 
 
-def _apply_causal(s, iq, ik, bq, bk, offset):
-    """Mask only when the block straddles the diagonal; blocks fully below
-    it skip the iota/compare/where entirely (attention here is VPU-bound —
-    the mask is ~30% of the vector work, needed on ~1/nk of blocks)."""
+def _apply_causal(s, iq, ik, bq, bk, offset, window=None):
+    """Mask only when the block straddles the diagonal (or the band's
+    lower edge); interior blocks skip the iota/compare/where entirely
+    (attention here is VPU-bound — the mask is ~30% of the vector work,
+    needed on ~1/nk of blocks)."""
     fully_visible = (ik + 1) * bk - 1 <= iq * bq + offset
+    if window is not None:
+        # also fully inside the band: hardest at (max row, min col)
+        fully_visible = jnp.logical_and(
+            fully_visible, ik * bk > iq * bq + (bq - 1) + offset - window
+        )
     return jax.lax.cond(
         fully_visible,
         lambda s: s,
-        lambda s: jnp.where(_causal_mask_block(iq, ik, bq, bk, offset), s, NEG_INF),
+        lambda s: jnp.where(
+            _causal_mask_block(iq, ik, bq, bk, offset, window), s, NEG_INF
+        ),
         s,
     )
 
@@ -102,7 +125,7 @@ def _apply_causal(s, iq, ik, bq, bk, offset):
 # forward
 # ---------------------------------------------------------------------- #
 def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
-                block_k: int, offset: int, padded: bool):
+                block_k: int, offset: int, padded: bool, window):
     if padded:
         lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
         kvlen = lens_ref[pl.program_id(0)]
@@ -120,7 +143,8 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
 
     # block is fully masked out when the q block sits above the diagonal
     # or entirely inside the padded kv tail
-    run = _block_visible(iq, ik, block_q, block_k, causal, offset, kvlen)
+    run = _block_visible(iq, ik, block_q, block_k, causal, offset, kvlen,
+                         window)
 
     @pl.when(run)
     def _body():
@@ -134,7 +158,7 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (bq, bk) f32
         if causal:
-            s = _apply_causal(s, iq, ik, block_q, block_k, offset)
+            s = _apply_causal(s, iq, ik, block_q, block_k, offset, window)
         if padded:
             s = _apply_kv_padding(s, ik, block_q, block_k, kvlen)
         m_prev = m_scr[:, 0:1]  # (bq, 1)
@@ -170,7 +194,7 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         )
 
 
-def _fwd(q, k, v, lengths, scale, causal, block_q, block_k):
+def _fwd(q, k, v, lengths, scale, causal, block_q, block_k, window):
     B, H, S, D = q.shape
     Hkv, Skv = k.shape[1], k.shape[2]
     g = H // Hkv
@@ -200,7 +224,7 @@ def _fwd(q, k, v, lengths, scale, causal, block_q, block_k):
     ]
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        offset=Skv - S, padded=padded,
+        offset=Skv - S, padded=padded, window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -219,7 +243,8 @@ def _fwd(q, k, v, lengths, scale, causal, block_q, block_k):
 # ---------------------------------------------------------------------- #
 # backward
 # ---------------------------------------------------------------------- #
-def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, padded):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, padded,
+                   window):
     if padded:
         (lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dq_ref, acc_scr) = refs
@@ -234,7 +259,8 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, padded):
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = _block_visible(iq, ik, block_q, block_k, causal, offset, kvlen)
+    run = _block_visible(iq, ik, block_q, block_k, causal, offset, kvlen,
+                         window)
 
     @pl.when(run)
     def _body():
@@ -248,7 +274,7 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, padded):
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            s = _apply_causal(s, iq, ik, block_q, block_k, offset)
+            s = _apply_causal(s, iq, ik, block_q, block_k, offset, window)
         if padded:
             s = _apply_kv_padding(s, ik, block_q, block_k, kvlen)
         if padded or (causal and offset < 0):
@@ -274,7 +300,7 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, padded):
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, group, offset,
-                    padded):
+                    padded, window):
     # grid: (B, Hkv, n_kv, G, n_q) — dk/dv blocks live across (G, n_q)
     if padded:
         (lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -293,7 +319,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, group, offset,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = _block_visible(iq, ik, block_q, block_k, causal, offset, kvlen)
+    run = _block_visible(iq, ik, block_q, block_k, causal, offset, kvlen,
+                         window)
 
     @pl.when(run)
     def _body():
@@ -307,7 +334,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, group, offset,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            s = _apply_causal(s, iq, ik, block_q, block_k, offset)
+            s = _apply_causal(s, iq, ik, block_q, block_k, offset, window)
         if padded:
             s = _apply_kv_padding(s, ik, block_q, block_k, kvlen)
         if padded or (causal and offset < 0):
@@ -333,7 +360,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, group, offset,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, dout):
+def _bwd(scale, causal, block_q, block_k, window, res, dout):
     q, k, v, lengths, out, lse = res
     B, H, S, D = q.shape
     Hkv, Skv = k.shape[1], k.shape[2]
@@ -356,7 +383,7 @@ def _bwd(scale, causal, block_q, block_k, res, dout):
     dq_out_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik, *refs: (b, h, iq, 0))
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        offset=Skv - S, padded=padded,
+        offset=Skv - S, padded=padded, window=window,
     )
     dq_scratch = [pltpu.VMEM((bq, D), jnp.float32)]
     prefix = (lengths,) if padded else ()
@@ -394,7 +421,7 @@ def _bwd(scale, causal, block_q, block_k, res, dout):
     ]
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        group=g, offset=Skv - S, padded=padded,
+        group=g, offset=Skv - S, padded=padded, window=window,
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -413,17 +440,17 @@ def _bwd(scale, causal, block_q, block_k, res, dout):
 # ---------------------------------------------------------------------- #
 # public wrapper with custom VJP
 # ---------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, lengths, scale, causal, block_q, block_k):
-    out, _ = _fwd(q, k, v, lengths, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, lengths, scale, causal, block_q, block_k, window):
+    out, _ = _fwd(q, k, v, lengths, scale, causal, block_q, block_k, window)
     return out
 
-def _flash_fwd(q, k, v, lengths, scale, causal, block_q, block_k):
-    out, lse = _fwd(q, k, v, lengths, scale, causal, block_q, block_k)
+def _flash_fwd(q, k, v, lengths, scale, causal, block_q, block_k, window):
+    out, lse = _fwd(q, k, v, lengths, scale, causal, block_q, block_k, window)
     return out, (q, k, v, lengths, out, lse)
 
-def _flash_bwd(scale, causal, block_q, block_k, res, dout):
-    return _bwd(scale, causal, block_q, block_k, res, dout)
+def _flash_bwd(scale, causal, block_q, block_k, window, res, dout):
+    return _bwd(scale, causal, block_q, block_k, window, res, dout)
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
@@ -437,8 +464,15 @@ def flash_attention(
     kv_lengths: Optional[jax.Array] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention, (batch, seq, heads, head_dim) layout, GQA-aware.
+
+    ``window`` (requires ``causal``): the Mistral/Qwen2 sliding-window
+    band — query row r sees keys (r - window, r], HF semantics. kv blocks
+    entirely below the band are SKIPPED in forward and both backward
+    passes (the same block-skip machinery as the causal upper triangle),
+    so compute scales with S*window instead of S^2/2.
 
     ``causal=False`` runs full bidirectional attention (the BERT-family
     encoder path). ``kv_lengths`` (B,) int32 marks keys ``[0, len)`` valid
@@ -454,6 +488,12 @@ def flash_attention(
     xla path.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if window is not None:
+        if not causal:
+            raise ValueError("sliding window requires causal attention")
+        window = int(window)
+        if window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
     # (B,S,H,D) -> (B,H,S,D)
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     bq = fit_block(qt.shape[2], block_q)
@@ -470,5 +510,5 @@ def flash_attention(
                 f"{kv_lengths.shape}"
             )
         kv_lengths = kv_lengths.astype(jnp.int32)
-    out = _flash(qt, kt, vt, kv_lengths, scale, causal, bq, bk)
+    out = _flash(qt, kt, vt, kv_lengths, scale, causal, bq, bk, window)
     return jnp.swapaxes(out, 1, 2)

@@ -76,7 +76,9 @@ def moe_ragged(
     (the dots policy recomputes ragged_dot in backward); use the
     "dots_ragged" policy (models/transformer._REMAT_POLICIES), which
     saves grouped-matmul outputs too (h=4096: 0.509 with dots_ragged).
-    This is why ``moe_dispatch="auto"`` resolves to ragged at ep==1.
+    This is why ``moe_dispatch="auto"`` resolves to ragged at ep==1
+    (and to :func:`moe_ragged_ep` at ep>1 — its docstring carries the
+    drop-rate/collective-bytes evidence).
 
     Fully differentiable (ragged_dot has grad rules; sort / gather /
     scatter-add are linear).
@@ -108,6 +110,19 @@ def moe_ragged(
     # combine: weighted scatter-add back into token order (sums the K
     # expert contributions per token)
     return jnp.zeros((T, h), out.dtype).at[tok].add(out * w_flat[:, None])
+
+
+def ragged_ep_supported() -> bool:
+    """Whether this jax has the partial-manual shard_map mode
+    (``axis_names``) that :func:`moe_ragged_ep` requires. The auto
+    dispatch resolves to capacity when it is absent."""
+    import inspect
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-top-level-shard_map jax: experimental only,
+        return False     # which also predates partial-manual mode
+    return "axis_names" in inspect.signature(shard_map).parameters
 
 
 def moe_ragged_ep(
@@ -142,6 +157,17 @@ def moe_ragged_ep(
     expert overflowing), and the expert matmuls stay ragged-packed.
     ``capacity_factor >= ep`` (each shard's window covers all T*K rows)
     cannot drop and equals the dense oracle exactly.
+
+    Measured (r5, the evidence behind ``moe_dispatch="auto"`` resolving
+    here at ep>1; both schedules compute the same cf*T*K padded row-FLOPs
+    so drops and comm decide): at T=8192 E=8 K=2 cf=1.25 with
+    Gumbel-perturbed Dirichlet routing, per-expert capacity drops
+    3.5%/9.5%/23.7% of token-choices at Dirichlet concentration
+    10/3/1 (ep=2) where this schedule drops 0%/1.0%/2.9% — 3-10x fewer
+    at every skew tried, both ep=2 and ep=4; and the compiled fwd+bwd
+    CausalLM step on a dp=2 x ep=4 CPU mesh moves 2.5 MB of collective
+    output bytes vs capacity's 5.2 MB (~2.1x; all-gather 0.32 MB vs
+    1.78 MB, all-reduce 2.19 MB vs 3.41 MB).
 
     Built as a nested shard_map manual over ONLY the ep axis (the same
     context-mesh pattern as ring attention under pp, with
@@ -214,9 +240,7 @@ def moe_ragged_ep(
     sm_mesh = ctx if ctx is not None else mesh
     from jax import shard_map
 
-    import inspect
-
-    if "axis_names" not in inspect.signature(shard_map).parameters:
+    if not ragged_ep_supported():
         # full-manual would manualize dp/fsdp too: in_specs P() for the
         # activations would all-gather the global batch onto every device
         # (dp-times redundant FLOPs + memory) — refuse, like
@@ -296,8 +320,16 @@ def _constrain_expert_buffer(buf: jax.Array) -> jax.Array:
     from ..parallel.sharding import live_mesh
     from ..utils.constants import MESH_AXIS_DATA, MESH_AXIS_EXPERT, MESH_AXIS_FSDP
 
+    from ..utils.operations import nested_manual_mesh
+
     mesh = live_mesh()
     if mesh is None or mesh.shape.get(MESH_AXIS_EXPERT, 1) <= 1:
+        return buf
+    if nested_manual_mesh() is not None:
+        # inside a pipeline stage body the concrete mesh no longer
+        # matches the trace; a constraint here would raise. The capacity
+        # path under pp runs unconstrained — moe_ragged_ep (the ep>1
+        # default) is the pinned-layout pipeline path.
         return buf
     if buf.shape[0] % mesh.shape[MESH_AXIS_EXPERT]:
         return buf

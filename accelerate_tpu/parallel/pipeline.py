@@ -18,11 +18,12 @@ AND with sp — the stage shard_map is PARTIAL-MANUAL
 (``axis_names={"pp"}``): only the pp axis is manual; every other mesh
 axis stays automatic, so GSPMD partitions the stage body over
 tp/dp/fsdp/sp and inserts their collectives inside each pipeline stage
-(the Megatron pp x tp and pp x sp layouts, reference
-utils/dataclasses.py:1323,1338, reached with zero engine code). Ring
-attention under pp nests its own sp shard_map on the context mesh
-(ops/ring_attention.py). ep inside a stage remains rejected in
-:func:`validate_pipeline_plugin`.
+(the Megatron pp x tp, pp x sp and pp x ep layouts, reference
+utils/dataclasses.py:1323,1338 and utils/megatron_lm.py:1641-, reached
+with zero engine code). Ring attention under pp nests its own sp
+shard_map on the context mesh (ops/ring_attention.py); moe_ragged_ep
+nests its ep shard_map the same way (ops/moe.py) — the r5 lift of the
+last composition rejection.
 
 Two schedules:
 
@@ -92,9 +93,9 @@ def _stage_shard_map(mesh, in_specs, out_specs):
 def validate_pipeline_plugin(
     plugin: ParallelismPlugin, resolved_shape: Optional[dict] = None
 ) -> None:
-    """pp>1 with sp/ep>1 (or tp>1 without partial-manual shard_map) would
-    need collectives nested inside the stage shard_map — reject instead of
-    silently mis-sharding.
+    """pp>1 with tp/sp/ep>1 needs partial-manual shard_map (the nested
+    collectives live inside the stage body) — reject on older jax instead
+    of silently mis-sharding.
 
     ``resolved_shape`` (from ``resolve_mesh_shape``) covers the ``-1`` auto
     axes — validation must run on the *resolved* degrees, else ``pp_size=-1``
@@ -110,31 +111,26 @@ def validate_pipeline_plugin(
     pp = sizes.pop("pp")
     if pp in (1, -1):
         return
-    # tp AND sp compose since partial-manual shard_map (both stay auto
-    # axes inside the stage body; ring attention nests its own sp
-    # shard_map on the context mesh — ops/ring_attention.py). On older
-    # jax full-manual would silently replicate tp (duplicate compute +
-    # per-step weight all-gather) and cannot nest the sp ring, so both
-    # are rejected there. ep under pp would put the expert all-to-all
-    # under the schedule — still rejected everywhere (untested).
+    # tp, sp AND ep compose since partial-manual shard_map (all stay auto
+    # axes inside the stage body; ring attention and moe_ragged_ep nest
+    # their own sp/ep shard_maps on the context mesh —
+    # ops/ring_attention.py, ops/moe.py). On older jax full-manual would
+    # silently replicate tp (duplicate compute + per-step weight
+    # all-gather) and cannot nest the sp ring or the ep dispatch, so all
+    # three are rejected there.
     tp = (
         resolved_shape["tp"] if resolved_shape is not None else plugin.tp_size
     )
     sp = sizes.pop("sp_size")
+    ep = sizes.pop("ep_size")
     if not _PARTIAL_MANUAL:
-        for name, v in (("tp_size", tp), ("sp_size", sp)):
+        for name, v in (("tp_size", tp), ("sp_size", sp), ("ep_size", ep)):
             if v not in (1, -1):
                 raise NotImplementedError(
                     f"pp_size={pp} with {name}={v} needs jax shard_map "
                     "partial-manual mode (axis_names), unavailable in this "
                     "jax version"
                 )
-    offending = {k: v for k, v in sizes.items() if v not in (1,)}
-    if offending:
-        raise NotImplementedError(
-            f"pipeline parallelism (pp_size={pp}) cannot yet be "
-            f"combined with {offending}; use pp with dp/fsdp/tp/sp only"
-        )
     if plugin.num_micro_batches < pp:
         raise ValueError(
             f"num_micro_batches ({plugin.num_micro_batches}) must be >= "

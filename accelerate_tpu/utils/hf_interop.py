@@ -28,11 +28,14 @@ rotation (HF ``rotate_half`` == models/transformer.rope), so weights
 interchange without any permutation of head dims.
 
 Architectures covered: the Llama family (Llama-2/3/3.1+ incl. GQA,
-llama3/linear rope scaling, tied or untied heads), Qwen2 (the Llama
-layout plus q/k/v biases — ``TransformerConfig.qkv_bias``), Gemma v1
-(offset RMSNorm / tanh-GELU gate / scaled embeddings —
+llama3/linear rope scaling, tied or untied heads), Mistral (the Llama
+layout + every-layer sliding window — ``TransformerConfig.sliding_window``
+— incl. NeMo's decoupled head_dim), Qwen2 (the Llama layout plus q/k/v
+biases — ``TransformerConfig.qkv_bias``; sliding window when every layer
+slides), Gemma v1 (offset RMSNorm / tanh-GELU gate / scaled embeddings —
 ``norm_offset``/``mlp_activation``/``embed_scale``; Gemma-2/3 rejected),
-Mixtral-style MoE — the BASELINE.md targets (Llama-3-8B FSDP, Mixtral 8x7B EP,
+Mixtral-style MoE (``sliding_window`` honored) — the BASELINE.md targets
+(Llama-3-8B FSDP, Mixtral 8x7B EP,
 Llama-3-70B device_map="auto") — and classic GPT-2 via the faithful
 :class:`~...models.gpt2.GPT2LM` (learned positions, LayerNorm, biases,
 fused c_attn; HF Conv1D already stores ``(in, out)`` so that mapping has
@@ -192,13 +195,38 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
     # below fails loudly, including on parameter keys missing for the
     # declared type, so nothing can only blow up at trace time.
     rope_scaling = hf.get("rope_scaling")
-    if model_type == "qwen2" and hf.get("use_sliding_window", False):
-        # the native attention has no sliding-window masking; loading
-        # would silently change long-range behavior
-        raise ValueError(
-            "Qwen2 checkpoints with use_sliding_window=true are not "
-            "supported by the native attention"
-        )
+    # sliding-window resolution (transformers semantics): Mistral and
+    # Mixtral apply the band to EVERY layer when config.sliding_window is
+    # set (modeling_mistral.py:355, modeling_mixtral.py:448); Qwen2
+    # zeroes it unless use_sliding_window
+    # (configuration_qwen2.py:181) and then derives per-layer layer_types
+    # with layers >= max_window_layers sliding (:204-209). The nn.scan
+    # layout compiles ONE homogeneous layer body, so all-sliding and
+    # all-full load; a genuine per-layer mix is rejected loudly.
+    sliding_window = None
+    if model_type in ("mistral", "mixtral"):
+        sliding_window = hf.get("sliding_window")
+    elif model_type == "qwen2" and hf.get("use_sliding_window", False):
+        sliding_window = hf.get("sliding_window")
+        if sliding_window is not None:
+            n = hf["num_hidden_layers"]
+            layer_types = hf.get("layer_types") or [
+                "sliding_attention"
+                if i >= hf.get("max_window_layers", 28)
+                else "full_attention"
+                for i in range(n)
+            ]
+            kinds = set(layer_types)
+            if kinds == {"full_attention"}:
+                sliding_window = None
+            elif kinds != {"sliding_attention"}:
+                raise ValueError(
+                    "Qwen2 checkpoints mixing sliding and full attention "
+                    f"layers (layer_types {sorted(kinds)}, max_window_layers"
+                    f"={hf.get('max_window_layers')}) are not supported: "
+                    "the nn.scan layout compiles one homogeneous layer "
+                    "body — only all-sliding or all-full loads"
+                )
     if model_type in ("gemma2", "gemma3", "gemma3_text"):
         # Gemma-2/3 add attention/final-logit soft-capping, pre+post
         # norms per block and sliding-window layers — math the native
@@ -209,15 +237,15 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
             "soft-capping/post-norms/sliding-window are not implemented "
             "(Gemma v1 loads via model_type 'gemma')"
         )
-    if model_type not in ("llama", "mixtral", "qwen2", "gemma"):
+    if model_type not in ("llama", "mistral", "mixtral", "qwen2", "gemma"):
         # Phi/... share the model.layers.* key convention and every
         # config field this mapping reads, but differ in parameters the
         # plan would silently drop — loading them would succeed and
         # generate garbage.
         raise ValueError(
             f"HF model_type {model_type!r} is not supported by the "
-            "parameter mappings; supported: llama, mixtral, qwen2, gemma, "
-            "gpt2"
+            "parameter mappings; supported: llama, mistral, mixtral, "
+            "qwen2, gemma, gpt2"
         )
     kw = dict(
         vocab_size=hf["vocab_size"],
@@ -231,10 +259,14 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
         rope_scaling=rope_scaling,
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        sliding_window=sliding_window,
         # the Qwen2 convention: biases on q/k/v only (hard-wired in the
         # arch, not a config.json field)
         qkv_bias=model_type == "qwen2",
     )
+    if model_type == "mistral" and hf.get("head_dim"):
+        # Mistral-NeMo decouples head_dim from hidden/num_heads
+        kw["head_dim"] = hf["head_dim"]
     if model_type == "gemma":
         act = hf.get("hidden_activation") or hf.get("hidden_act")
         if act not in (None, "gelu", "gelu_pytorch_tanh"):
@@ -589,11 +621,19 @@ def _export_arch(config) -> tuple[str, str]:
         )
     qkv = getattr(config, "qkv_bias", False)
     moe = bool(config.num_experts)
+    sw = getattr(config, "sliding_window", None) is not None
     if sum((is_gemma, qkv, moe)) > 1:
         raise ValueError(
             "no HF model_type represents this switch combination "
             f"(gemma-math={is_gemma}, qkv_bias={qkv}, moe={moe}); "
             "save a native checkpoint instead"
+        )
+    if sw and is_gemma:
+        # GemmaConfig (v1) has no sliding_window field — transformers
+        # would drop the band silently on reload
+        raise ValueError(
+            "no HF model_type represents Gemma-v1 math with a sliding "
+            "window; save a native checkpoint instead"
         )
     if is_gemma and not config.tie_embeddings:
         raise ValueError(
@@ -607,6 +647,10 @@ def _export_arch(config) -> tuple[str, str]:
         return "GemmaForCausalLM", "gemma"
     if qkv:
         return "Qwen2ForCausalLM", "qwen2"
+    if sw:
+        # LlamaConfig has no sliding_window; the Llama layout + band IS
+        # Mistral
+        return "MistralForCausalLM", "mistral"
     return "LlamaForCausalLM", "llama"
 
 
@@ -739,6 +783,17 @@ def save_hf_checkpoint(
     if mt == "gemma":
         hf_cfg["head_dim"] = config.head_dim
         hf_cfg["hidden_activation"] = "gelu_pytorch_tanh"
+    sw = getattr(config, "sliding_window", None)
+    if mt in ("mistral", "mixtral"):
+        hf_cfg["sliding_window"] = sw  # None -> full attention, HF default
+        if mt == "mistral":
+            hf_cfg["head_dim"] = config.head_dim
+    elif mt == "qwen2" and sw is not None:
+        # every layer slides (infer_config_from_hf round-trips this via
+        # the derived layer_types)
+        hf_cfg["use_sliding_window"] = True
+        hf_cfg["sliding_window"] = sw
+        hf_cfg["max_window_layers"] = 0
     if config.num_experts:
         hf_cfg["num_local_experts"] = config.num_experts
         hf_cfg["num_experts_per_tok"] = config.num_experts_per_tok

@@ -164,6 +164,10 @@ def _configs(on_tpu: bool):
             ), 1, 4096, 8, 2, "sgd",
         ),
         "decode": (decode, 1, 128, 64, 1),  # B, prompt_len, new_tokens, reps
+        # checkpoint-open -> device-resident for the decode model; its own
+        # variant so a slow/failed load can never cost the decode headline
+        # (folded into the decode line's extra as load_s)
+        "decode_load": (decode, 1, 0, 0, 0),
     }
 
 
@@ -250,13 +254,17 @@ def _mfu(cfg, n_params: int, seq: int, tokens_per_sec_chip: float) -> float:
 
 def _run_decode(cfg, batch_size: int, prompt_len: int, new_tokens: int,
                 reps: int):
-    """Autoregressive generation benchmark -> (s/token, n_params).
+    """Autoregressive generation benchmark -> (s/token, n_params, load_s).
 
     Params are random-initialized DIRECTLY in bf16 on device (a standard
     fp32 init of a ~5.5B model would not fit 16G); decode quality is
     irrelevant to throughput — the per-token cost is reading the resident
     weights once per step (memory-bound), which random weights measure
     exactly.
+
+    Load time is measured by the separate ``decode_load`` helper variant
+    (folded into this line's extra as ``load_s``) so a slow or failed
+    load can never cost the decode headline.
     """
     import numpy as np
 
@@ -303,8 +311,105 @@ def _run_decode(cfg, batch_size: int, prompt_len: int, new_tokens: int,
     return dt / (reps * new_tokens), n_params
 
 
+def _run_decode_load(cfg):
+    """Checkpoint-open -> device-resident seconds for the decode model
+    (VERDICT r4 missing #4: the reference's headline table couples load
+    seconds with s/token — GPT-J 8.7 s, benchmarks/README.md:31).
+
+    The sharded bf16 safetensors checkpoint is synthesized HOST-side
+    (same shapes the decode variant serves; writing from device would pay
+    an 11 GiB device->host pull that measures nothing). The timed section
+    is the real serving cold path users run: streamed
+    ``load_checkpoint_and_dispatch`` from disk to device-resident.
+    On this rig the chip is axon-tunneled at ~0.03 GiB/s each way, so
+    device residency is link-bound, not framework-bound — the
+    disk->host streaming time (the framework's own work) and the
+    host->device push are reported separately so the number stays
+    interpretable against the reference's local-PCIe 8.7 s.
+    """
+    import shutil
+    import tempfile
+
+    import ml_dtypes
+    import numpy as np
+
+    from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+    from accelerate_tpu.checkpointing import save_model_weights
+    from accelerate_tpu.models import CausalLM, count_params
+    from accelerate_tpu.parallel.sharding import unbox_params
+
+    _reset_state()
+    model = CausalLM(cfg)
+    abstract = unbox_params(
+        jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )
+        )
+    )["params"]
+    rng = np.random.default_rng(0)
+    host = jax.tree.map(
+        lambda l: rng.standard_normal(l.shape, np.float32)
+        .astype(ml_dtypes.bfloat16),
+        abstract,
+    )
+    n_params = count_params(host)
+    nbytes = sum(l.nbytes for l in jax.tree_util.tree_leaves(host))
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_decode_ckpt_")
+    try:
+        save_model_weights(host, ckpt_dir, max_shard_size="2GB")
+        del host
+        abstract_bf16 = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, jnp.bfloat16), abstract
+        )
+        from accelerate_tpu.big_modeling import _lazy_checkpoint_reader
+        from accelerate_tpu.checkpointing import _path_str
+
+        # attribution leg: the framework's own streaming work —
+        # checkpoint-open + assemble every tensor host-side, no jax
+        # placement (pure disk + numpy)
+        read = _lazy_checkpoint_reader(ckpt_dir)
+        flat, _ = jax.tree_util.tree_flatten_with_path(abstract_bf16)
+        t0 = time.perf_counter()
+        acc = 0
+        for path, _tmpl in flat:
+            acc += read(_path_str(path)).nbytes
+        disk_to_host_s = time.perf_counter() - t0
+        assert acc == nbytes
+
+        # the serving cold path users run: checkpoint-open ->
+        # device-resident in one streamed call (peak host = one leaf)
+        t1 = time.perf_counter()
+        params = load_checkpoint_and_dispatch(
+            abstract_bf16, ckpt_dir, device_map={"": 0},
+        )
+        np.asarray(jax.tree_util.tree_leaves(params)[-1].ravel()[:1])
+        load_s = time.perf_counter() - t1
+        return {
+            "metric": "checkpoint_load_seconds",
+            "value": round(load_s, 2),
+            "unit": "s",
+            # reference pairs 8.7 s load with its decode headline
+            "vs_baseline": round(8.7 / load_s, 4),
+            "extra": {
+                "disk_to_host_s": round(disk_to_host_s, 2),
+                "host_to_device_s": round(load_s - disk_to_host_s, 2),
+                "gib": round(nbytes / 2**30, 2),
+                "params": n_params,
+                "load_ref_s": 8.7,
+                "note": "host->device rides the axon tunnel "
+                "(~0.03 GiB/s measured) — link-bound, not framework-bound; "
+                "disk_to_host_s is the framework's own streaming time",
+            },
+        }
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
 def _result_line(name, cfg, batch_size, seq, iters, warmup,
                  optimizer="adamw") -> dict:
+    if name == "decode_load":
+        return _run_decode_load(cfg)
     if name == "decode":
         prompt_len, new_tokens, reps = seq, iters, warmup
         s_token, n_params = _run_decode(
@@ -412,7 +517,10 @@ def main():
             try:
                 proc = subprocess.run(
                     [sys.executable, __file__, name], text=True,
-                    capture_output=True, timeout=900,
+                    capture_output=True,
+                    # decode_load moves ~11 GiB across the ~0.03 GiB/s
+                    # axon tunnel — genuinely slow, not hung
+                    timeout=1800 if name == "decode_load" else 900,
                 )
             except subprocess.TimeoutExpired:
                 # discard any implausible first-attempt record too — never
@@ -470,6 +578,22 @@ def main():
             results[name] = rec
         else:
             errors[name] = err or "no output"
+    # fold the load-time helper into the decode line (never the reverse:
+    # a failed load leaves the decode headline intact with load_s null)
+    if "decode" in results:
+        extra = results["decode"]["extra"]
+        if "decode_load" in results:
+            rec_l = results.pop("decode_load")
+            extra["load_s"] = rec_l["value"]
+            extra["load_disk_to_host_s"] = rec_l["extra"]["disk_to_host_s"]
+            extra["load_host_to_device_s"] = rec_l["extra"]["host_to_device_s"]
+            extra["load_gib"] = rec_l["extra"]["gib"]
+            extra["load_ref_s"] = 8.7
+            extra["load_note"] = rec_l["extra"]["note"]
+        else:
+            extra["load_s"] = None
+            extra["load_error"] = errors.pop("decode_load", "unknown")[:160]
+
     helpers = ("longseq_xla", "longseq4k", "longseq_xla4k")
     if "longseq" in results:
         extra = results["longseq"]["extra"]

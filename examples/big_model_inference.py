@@ -70,6 +70,16 @@ def main():
         "utils/bnb.py:44): works on BOTH checkpoint formats, incl. "
         "--hf_checkpoint — the practical way to fit bigger models per chip",
     )
+    parser.add_argument(
+        "--tp", type=int, default=1,
+        help="Tensor-parallel degree for the GSPMD mode (the serving "
+        "layout of BASELINE.md's Llama-3-70B device_map='auto' config); "
+        "generation must stay token-identical to the tiered placement",
+    )
+    parser.add_argument(
+        "--fsdp", type=int, default=1,
+        help="Weight-shard degree for the GSPMD mode (composes with --tp)",
+    )
     args = parser.parse_args()
 
     workdir = tempfile.mkdtemp(prefix="big_model_")
@@ -107,7 +117,9 @@ def main():
         model.init, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
 
-    acc = Accelerator(parallelism_plugin=ParallelismPlugin())
+    acc = Accelerator(parallelism_plugin=ParallelismPlugin(
+        dp_size=-1, tp_size=args.tp, fsdp_size=args.fsdp, min_weight_size=1,
+    ))
     prompt = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size, (1, args.seq)),
         jnp.int32,

@@ -216,12 +216,15 @@ def test_memory_example():
 @pytest.mark.slow
 def test_big_model_inference_example():
     """Tiered big-model loading ends in identical generations across GSPMD
-    and device_map placements (the example asserts it internally)."""
+    and device_map placements (the example asserts it internally). The
+    GSPMD mode runs tp=2 x fsdp=2 (r5: the BASELINE.md Llama-3-70B
+    serving layout at tiny scale), so the internal equality IS the
+    sharded-vs-unsharded token-for-token check."""
     import runpy
 
     old_argv = sys.argv
     sys.argv = ["big_model_inference.py", "--max_memory_mb", "0.5",
-                "--new_tokens", "4"]
+                "--new_tokens", "4", "--tp", "2", "--fsdp", "2"]
     try:
         runpy.run_path(
             str(EXAMPLES / "big_model_inference.py"), run_name="__main__"

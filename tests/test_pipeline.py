@@ -149,17 +149,17 @@ def test_pipeline_training_via_unified_step():
 
 def test_pipeline_plugin_validation():
     # pp x tp composes since v2 (partial-manual shard_map); pp x sp since
-    # v3 (ring attention nests its sp shard_map on the context mesh)
+    # v3 (ring attention nests its sp shard_map on the context mesh);
+    # pp x ep since r5 (moe_ragged_ep nests its ep shard_map the same way)
     validate_pipeline_plugin(
         ParallelismPlugin(pp_size=2, tp_size=2, num_micro_batches=4)
     )
     validate_pipeline_plugin(
         ParallelismPlugin(pp_size=2, sp_size=2, num_micro_batches=4)
     )
-    with pytest.raises(NotImplementedError, match="cannot yet be combined"):
-        validate_pipeline_plugin(
-            ParallelismPlugin(pp_size=2, ep_size=2, num_micro_batches=4)
-        )
+    validate_pipeline_plugin(
+        ParallelismPlugin(pp_size=2, ep_size=2, num_micro_batches=4)
+    )
     with pytest.raises(ValueError, match="num_micro_batches"):
         validate_pipeline_plugin(
             ParallelismPlugin(pp_size=4, num_micro_batches=2)
@@ -167,14 +167,15 @@ def test_pipeline_plugin_validation():
 
 
 def test_auto_pp_size_still_validated():
-    """pp_size=-1 resolving to >1 must hit the same sp/ep rejection as an
-    explicit pp_size (review finding: -1 skipped validation entirely)."""
+    """pp_size=-1 resolving to >1 must hit the same post-resolution checks
+    as an explicit pp_size (review finding: -1 skipped validation
+    entirely). With tp/sp/ep all composing now, the surviving resolved
+    check is the microbatch bound."""
     from accelerate_tpu.parallel import build_mesh
 
-    with pytest.raises(NotImplementedError, match="pipeline parallelism"):
+    with pytest.raises(ValueError, match="num_micro_batches"):
         build_mesh(
-            ParallelismPlugin(dp_size=2, pp_size=-1, ep_size=2,
-                              num_micro_batches=4)
+            ParallelismPlugin(dp_size=2, pp_size=-1, num_micro_batches=2)
         )
 
 
@@ -287,6 +288,98 @@ def test_1f1b_composes_with_sp_ring_attention():
     for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(g_ref)):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-3
+        )
+
+
+def test_1f1b_composes_with_ep_ragged_moe():
+    """pp=2 x ep=2 (VERDICT r4 missing #2, the last composition
+    rejection): a stage body containing the shard-capacity ragged MoE
+    runs under the 1F1B schedule — ep stays an auto axis of the
+    partial-manual stage, and moe_ragged_ep's own shard_map nests on the
+    context mesh (the same move that landed sp-under-pp). Loss and grads
+    must match the sequential dense-dispatch oracle (capacity_factor ==
+    ep: the window covers every row, zero drops, exact math)."""
+    from accelerate_tpu.ops.moe import moe_ragged_ep
+
+    E, K = 4, 2
+
+    def _moe_params(key=0):
+        ks = jax.random.split(jax.random.PRNGKey(key), 4)
+        return {
+            "router": jax.random.normal(ks[0], (L, H, E)) / np.sqrt(H),
+            "wg": jax.random.normal(ks[1], (L, E, H, F)) / np.sqrt(H),
+            "wu": jax.random.normal(ks[2], (L, E, H, F)) / np.sqrt(H),
+            "wd": jax.random.normal(ks[3], (L, E, F, H)) / np.sqrt(F),
+        }
+
+    def _route(layer, h):
+        logits = h @ layer["router"]  # (T, E)
+        w, sel = jax.lax.top_k(jax.nn.softmax(logits, -1), K)
+        return sel, w / jnp.sum(w, -1, keepdims=True)
+
+    def moe_block(mesh):
+        def fn(local_params, x):
+            def body(h, layer):
+                sel, w = _route(layer, h)
+                out = moe_ragged_ep(
+                    h, sel, w, layer["wg"], layer["wu"], layer["wd"],
+                    mesh=mesh, capacity_factor=2.0,  # == ep: exact
+                )
+                return h + out, None
+
+            h, _ = jax.lax.scan(body, x, local_params)
+            return h
+
+        return fn
+
+    def dense_block(local_params, x):
+        def body(h, layer):
+            sel, w = _route(layer, h)
+            hid = jax.nn.silu(
+                jnp.einsum("th,ehf->tef", h, layer["wg"])
+            ) * jnp.einsum("th,ehf->tef", h, layer["wu"])
+            out = jnp.einsum("tef,efh->teh", hid, layer["wd"])  # (T,E,H)
+            T = h.shape[0]
+            combine = jnp.zeros((T, E)).at[
+                jnp.arange(T)[:, None], sel
+            ].set(w)
+            return h + jnp.sum(out * combine[..., None], axis=1), None
+
+        h, _ = jax.lax.scan(body, x, local_params)
+        return h
+
+    plugin = ParallelismPlugin(
+        dp_size=2, pp_size=2, ep_size=2,
+        sharding_strategy=ShardingStrategy.NO_SHARD, num_micro_batches=4,
+    )
+    validate_pipeline_plugin(plugin)  # the lifted rejection
+    mesh = build_mesh(plugin)
+    params = _moe_params()
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, H))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (16, H))
+    ps = jax.device_put(params, stacked_layer_shardings(params, mesh))
+
+    from accelerate_tpu.parallel.pipeline import pipeline_train_step
+
+    loss, grads = jax.jit(
+        lambda p, xx, tt: pipeline_train_step(
+            moe_block(mesh), _mse, p, xx, tt, mesh=mesh,
+            num_micro_batches=4,
+        )
+    )(ps, x, tgt)
+
+    def seq(p):
+        xm = x.reshape(4, 4, H)
+        tm = tgt.reshape(4, 4, H)
+        return jnp.mean(
+            jax.vmap(lambda a, b: _mse(dense_block(p, a), b))(xm, tm)
+        )
+
+    l_ref, g_ref = jax.value_and_grad(seq)(params)
+    np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
         )
 
 
@@ -405,6 +498,98 @@ def test_1f1b_feed_sharding_cuts_input_memory():
     np.testing.assert_allclose(float(l_s), float(l_r), rtol=1e-6)
     for a, b in zip(jax.tree.leaves(g_s), jax.tree.leaves(g_r)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_unified_pipeline_step_fp16_gradscaler():
+    """fp16 loss scaling under 1F1B (VERDICT r4 missing #3, the last AMP
+    rejection): scaling each microbatch loss scales the cotangents the
+    schedule seeds at the last stage; grads unscale at the top with the
+    same GradScaler semantics as unified_step. Checks: (a) a sane scale
+    trains to the fp32 trajectory within fp16 tolerance, (b) a forced
+    overflow skips the update (params held), halves the scale and reports
+    grads_finite=False — mirroring test_fp16_loss_scaling_step under
+    pp=2."""
+    from accelerate_tpu import MixedPrecisionPolicy
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    def run_fp16(loss_scale_init, steps=3):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        policy = MixedPrecisionPolicy.from_precision("fp16")
+        policy.loss_scale_init = loss_scale_init
+        plugin = ParallelismPlugin(
+            dp_size=4, pp_size=2,
+            sharding_strategy=ShardingStrategy.NO_SHARD, num_micro_batches=4,
+        )
+        acc = Accelerator(
+            mixed_precision="fp16", mixed_precision_policy=policy,
+            parallelism_plugin=plugin,
+        )
+        params = _stacked_params()
+        params = jax.device_put(params, stacked_layer_shardings(params, acc.mesh))
+        acc._models.append(params)
+        opt = acc.prepare(optax.sgd(1e-2))
+        carry = acc.init_carry(params, opt)
+        assert "loss_scale" in carry
+        step = acc.unified_pipeline_step(_block_fn, _mse, max_grad_norm=10.0)
+        rng = np.random.default_rng(0)
+        metrics = None
+        for _ in range(steps):
+            x = jnp.asarray(rng.normal(size=(16, H)), jnp.float32)
+            y = jnp.asarray(rng.normal(size=(16, H)), jnp.float32)
+            carry, metrics = step(carry, x, y)
+        return carry, metrics
+
+    def run_fp32(steps=3):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        plugin = ParallelismPlugin(
+            dp_size=4, pp_size=2,
+            sharding_strategy=ShardingStrategy.NO_SHARD, num_micro_batches=4,
+        )
+        acc = Accelerator(parallelism_plugin=plugin)
+        params = _stacked_params()
+        params = jax.device_put(params, stacked_layer_shardings(params, acc.mesh))
+        acc._models.append(params)
+        opt = acc.prepare(optax.sgd(1e-2))
+        carry = acc.init_carry(params, opt)
+        step = acc.unified_pipeline_step(_block_fn, _mse, max_grad_norm=10.0)
+        rng = np.random.default_rng(0)
+        for _ in range(steps):
+            x = jnp.asarray(rng.normal(size=(16, H)), jnp.float32)
+            y = jnp.asarray(rng.normal(size=(16, H)), jnp.float32)
+            carry, _ = step(carry, x, y)
+        return carry
+
+    # (a) sane scale: trains, loss reported at user scale, trajectory
+    # matches fp32 within half-precision tolerance
+    carry16, m16 = run_fp16(2.0**8)
+    assert bool(m16["grads_finite"])
+    assert float(m16["loss"]) < 20.0  # unscaled loss, not 256x
+    carry32 = run_fp32()
+    for a, b in zip(
+        jax.tree.leaves(carry16["params"]), jax.tree.leaves(carry32["params"])
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
+    # master params stay fp32
+    assert carry16["params"]["w"].dtype == jnp.float32
+
+    # (b) forced overflow: fp16 cotangents at scale 2^20 overflow; the
+    # update must be SKIPPED (params identical) and the scale halved
+    before = _stacked_params()
+    carry_of, m_of = run_fp16(2.0**20, steps=1)
+    assert not bool(m_of["grads_finite"])
+    for a, b in zip(
+        jax.tree.leaves(carry_of["params"]), jax.tree.leaves(before)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+    assert float(carry_of["loss_scale"].scale) == 2.0**19
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
 
 
 def test_unified_pipeline_step_trains():
