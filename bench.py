@@ -136,6 +136,16 @@ def _configs(on_tpu: bool):
         # Residual gap to 0.60 is structural at B=1/S=8192: ~11% of
         # counted FLOPs are attention (flash bwd runs below dense-matmul
         # MXU efficiency) plus the remaining attn-path recompute.
+        # r5: the one lever the accounting pointed at — a fused
+        # single-pass flash backward (5 matmuls/pair vs two-pass's 7) —
+        # was built and MEASURED at this shape: 8,137 ms/step vs the
+        # two-pass 310/312 ms (chip re-verified healthy between runs).
+        # TPU Pallas's consecutive-output-visit rule forces the fused
+        # form through a collapsing index map + full-sequence VMEM
+        # scratch that defeats Mosaic pipelining (and 1024-blocks
+        # overflow the 16 MiB scoped vmem). The two-pass backward is
+        # the structural optimum here — see ops/flash_attention.py's
+        # FUSED_BWD block for the full record.
         vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=2, num_heads=32, num_kv_heads=8, max_seq_len=8192,
         dtype="bfloat16", remat="save_mlp", attention_impl="flash",

@@ -36,6 +36,15 @@ def _qkv(B=1, S=256, H=4, Hkv=2, D=64, dtype=jnp.float32, seed=0):
     return q, k, v
 
 
+def _assert_grads_close(got, want, atol=2e-2):
+    """Compare grad triples normalized by the reference's max magnitude."""
+    for a, b in zip(got, want):
+        scale = float(jnp.max(jnp.abs(b))) + 1e-6
+        np.testing.assert_allclose(
+            np.asarray(a) / scale, np.asarray(b) / scale, atol=atol
+        )
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_forward_matches_xla(causal):
     q, k, v = _qkv()
@@ -61,11 +70,43 @@ def test_backward_matches_xla():
     with _kernel_mode():  # backward kernels run here too
         g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(g1, g2):
-        scale = float(jnp.max(jnp.abs(b))) + 1e-6
-        np.testing.assert_allclose(
-            np.asarray(a) / scale, np.asarray(b) / scale, atol=2e-2
+    _assert_grads_close(g1, g2)
+
+
+@pytest.mark.parametrize("padded", [False, True])
+def test_fused_backward_matches_xla(padded):
+    """The single-pass fused backward (kept as the measured record of the
+    r5 attempt — 26x slower on-chip, see the FUSED_BWD comment block)
+    must stay numerically correct: dq/dk/dv vs the dense oracle, GQA and
+    kv-length padding included."""
+    import accelerate_tpu.ops.flash_attention as fa
+
+    q, k, v = _qkv(S=256)
+    lengths = jnp.asarray([160], jnp.int32) if padded else None
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=64,
+                kv_lengths=lengths,
+            ) ** 2
         )
+
+    def loss_ref(q, k, v):
+        from accelerate_tpu.ops.attention import lengths_to_mask
+
+        mask = lengths_to_mask(lengths, k.shape[1]) if padded else None
+        return jnp.sum(xla_attention(q, k, v, causal=True, mask=mask) ** 2)
+
+    old = fa.FUSED_BWD
+    fa.FUSED_BWD = True
+    try:
+        with _kernel_mode():
+            g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        fa.FUSED_BWD = old
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    _assert_grads_close(g1, g2)
 
 
 @pytest.mark.parametrize("window", [1, 7, 64, 200, 1000])
@@ -104,11 +145,7 @@ def test_sliding_window_backward_matches_xla(window):
     with _kernel_mode():
         g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(g1, g2):
-        scale = float(jnp.max(jnp.abs(b))) + 1e-6
-        np.testing.assert_allclose(
-            np.asarray(a) / scale, np.asarray(b) / scale, atol=2e-2
-        )
+    _assert_grads_close(g1, g2)
 
 
 def test_sliding_window_decode_alignment():
@@ -186,11 +223,7 @@ def test_kv_lengths_backward_matches_xla(causal):
     np.testing.assert_array_equal(
         np.asarray(g1[1][1, 160:]), np.zeros_like(np.asarray(g1[1][1, 160:]))
     )
-    for a, b in zip(g1, g2):
-        scale = float(jnp.max(jnp.abs(b))) + 1e-6
-        np.testing.assert_allclose(
-            np.asarray(a) / scale, np.asarray(b) / scale, atol=2e-2
-        )
+    _assert_grads_close(g1, g2)
 
 
 def test_kv_lengths_zero_row():
