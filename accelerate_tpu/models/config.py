@@ -104,6 +104,22 @@ class TransformerConfig:
     # flash attention honor it (flash skips below-band kv blocks: work
     # scales with S*window); ring attention rejects it.
     sliding_window: Optional[int] = None
+    # Gemma-2 family switches (utils/hf_interop.py maps model_type
+    # "gemma2" onto these, on top of the Gemma-1 trio above):
+    # per-layer window pattern (tuple of int-or-None, len num_layers —
+    # Gemma-2 alternates sliding/full). Heterogeneous patterns ride the
+    # scan as a per-layer traced window, which only the xla attention
+    # path supports; homogeneous patterns should use sliding_window.
+    layer_windows: Optional[tuple] = None
+    # attention scale = query_pre_attn_scalar**-0.5 (Gemma-2 sets 256,
+    # decoupled from head_dim); None -> head_dim**-0.5
+    query_pre_attn_scalar: Optional[float] = None
+    # tanh soft-capping: s -> cap * tanh(s / cap) on attention scores
+    # (before masking) and on final logits
+    attn_softcap: Optional[float] = None
+    final_softcap: Optional[float] = None
+    # Gemma-2 block: norms AFTER attention and the MLP too (4 per block)
+    post_norms: bool = False
     attention_impl: Optional[str] = None  # None=auto | xla | flash | ring
     # MoE (Mixtral family); 0 experts = dense MLP
     num_experts: int = 0
@@ -162,6 +178,26 @@ class TransformerConfig:
                     "use attention_impl 'flash'/'xla'/None (flash's "
                     "band-skip already bounds work and memory at "
                     "window << seq)"
+                )
+        if self.layer_windows is not None:
+            self.layer_windows = tuple(self.layer_windows)
+            if len(self.layer_windows) != self.num_layers:
+                raise ValueError(
+                    f"layer_windows has {len(self.layer_windows)} entries "
+                    f"for {self.num_layers} layers"
+                )
+            if self.sliding_window is not None:
+                raise ValueError(
+                    "set either sliding_window (homogeneous) or "
+                    "layer_windows (per-layer), not both"
+                )
+            if not self.causal:
+                raise ValueError("layer_windows requires causal attention")
+            if self.attention_impl in ("ring", "flash"):
+                raise ValueError(
+                    "per-layer windows ride the scan as traced values, "
+                    "which only the xla attention path supports — use "
+                    "attention_impl 'xla' or None"
                 )
         if self.num_kv_heads is None:
             self.num_kv_heads = self.num_heads

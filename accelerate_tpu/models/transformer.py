@@ -159,9 +159,17 @@ class Attention(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, mask=None, kv_lengths=None):
+    def __call__(self, x, positions, mask=None, kv_lengths=None,
+                 layer_window=None):
         decode = self.decode
         cfg = self.config
+        # static homogeneous band, or the per-layer traced one (Gemma-2)
+        window = cfg.sliding_window if layer_window is None else layer_window
+        # Gemma-2 decouples the attention scale from head_dim
+        scale = (
+            cfg.query_pre_attn_scalar ** -0.5
+            if cfg.query_pre_attn_scalar is not None else None
+        )
         dtype = _dtype(cfg)
         q_dim = cfg.num_heads * cfg.head_dim
         kv_dim = cfg.num_kv_heads * cfg.head_dim
@@ -227,12 +235,11 @@ class Attention(nn.Module):
             cols = jnp.arange(max_len)[None, None, None, :]
             rows = (idx + jnp.arange(s))[None, None, :, None]
             dec_mask = cols <= rows  # (1,1,s,max_len)
-            if cfg.sliding_window is not None:
-                dec_mask = jnp.logical_and(
-                    dec_mask, cols > rows - cfg.sliding_window
-                )
+            if window is not None:
+                dec_mask = jnp.logical_and(dec_mask, cols > rows - window)
             out = dot_product_attention(
                 q, key_cache, value_cache, mask=dec_mask, causal=False,
+                scale=scale, softcap=cfg.attn_softcap,
                 implementation="xla",
             )
         else:
@@ -241,8 +248,9 @@ class Attention(nn.Module):
             out = dot_product_attention(
                 q, k, v, mask=mask, causal=cfg.causal,
                 kv_lengths=kv_lengths,
+                scale=scale, softcap=cfg.attn_softcap,
                 implementation=cfg.attention_impl,
-                window=cfg.sliding_window,
+                window=window,
             )
         # named residual: the "save_attn" remat policy keeps exactly these,
         # so backward never recomputes the attention kernel
@@ -430,23 +438,28 @@ class Block(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, mask=None, kv_lengths=None):
+    def __call__(self, x, positions, mask=None, kv_lengths=None,
+                 layer_window=None):
         from ..parallel.sharding import constrain_activations
 
         cfg = self.config
-        h = checkpoint_name(
-            x + Attention(cfg, decode=self.decode, name="attn")(
-                RMSNorm(cfg, name="attn_norm")(x), positions, mask, kv_lengths
-            ),
-            "attn_res",
+        attn_out = Attention(cfg, decode=self.decode, name="attn")(
+            RMSNorm(cfg, name="attn_norm")(x), positions, mask, kv_lengths,
+            layer_window,
         )
+        if cfg.post_norms:
+            # Gemma-2 block: a norm AFTER each sublayer too (pre + post,
+            # 4 per block — transformers Gemma2DecoderLayer)
+            attn_out = RMSNorm(cfg, name="post_attn_norm")(attn_out)
+        h = checkpoint_name(x + attn_out, "attn_res")
         ff = MoE(cfg, name="moe") if cfg.num_experts > 0 else MLP(cfg, name="mlp")
+        ff_out = ff(RMSNorm(cfg, name="mlp_norm")(h))
+        if cfg.post_norms:
+            ff_out = RMSNorm(cfg, name="post_mlp_norm")(ff_out)
         # pin the residual stream's layout once per layer so GSPMD cannot
         # alternate it between batch-sharded and weight-following layouts
         # (each flip is a full resharding per layer)
-        return constrain_activations(
-            h + ff(RMSNorm(cfg, name="mlp_norm")(h))
-        ), None
+        return constrain_activations(h + ff_out), None
 
 
 def _make_embed(cfg: TransformerConfig, dtype, name: Optional[str] = "embed") -> nn.Embed:
@@ -500,17 +513,32 @@ _REMAT_POLICIES = {
 }
 
 
+def _layer_windows_array(cfg: TransformerConfig):
+    """The (num_layers,) int32 per-layer window array for
+    ``cfg.layer_windows``, or None. Full-attention layers carry a
+    sentinel wider than any sequence, so ONE traced band formula covers
+    the whole scan (col > row - w is vacuous at w >= seq)."""
+    if cfg.layer_windows is None:
+        return None
+    return jnp.asarray(
+        [w if w is not None else (1 << 30) for w in cfg.layer_windows],
+        jnp.int32,
+    )
+
+
 def _apply_layer_stack(cfg: TransformerConfig, x, *extra, decode=False,
-                       block_cls=None, num_layers=None):
+                       block_cls=None, num_layers=None, per_layer=None):
     """Run a block stack (scan or unrolled, optional remat) on hidden
     states. Must be called inside an ``nn.compact`` context — the created
     modules attach to the calling module's scope, so CausalLM,
     SequenceClassifier and the seq2seq decoder share one implementation.
 
     ``extra``: per-call broadcast arguments of the block (positions, mask,
-    memory, ...). ``block_cls``: defaults to :class:`Block`; the seq2seq
-    decoder passes :class:`~.seq2seq.DecoderBlock`. Blocks must return
-    ``(x, None)``.
+    memory, ...). ``per_layer``: an optional (num_layers, ...) array
+    passed as the block's LAST positional argument, scanned over its
+    leading axis (the Gemma-2 per-layer window). ``block_cls``: defaults
+    to :class:`Block`; the seq2seq decoder passes
+    :class:`~.seq2seq.DecoderBlock`. Blocks must return ``(x, None)``.
     """
     base_cls = block_cls or Block
     block_kwargs = {"decode": decode}  # every block class supports decode
@@ -525,17 +553,23 @@ def _apply_layer_stack(cfg: TransformerConfig, x, *extra, decode=False,
     n = num_layers or cfg.num_layers
 
     if cfg.scan_layers:
+        in_axes = tuple(nn.broadcast for _ in extra)
+        args = extra
+        if per_layer is not None:
+            in_axes = in_axes + (0,)
+            args = extra + (per_layer,)
         x, _ = nn.scan(
             cls,
             variable_axes={"params": 0, "intermediates": 0, "cache": 0},
             split_rngs={"params": True},
-            in_axes=tuple(nn.broadcast for _ in extra),
+            in_axes=in_axes,
             length=n,
             metadata_params={nn.PARTITION_NAME: "layers"},
-        )(cfg, **block_kwargs, name="layers")(x, *extra)
+        )(cfg, **block_kwargs, name="layers")(x, *args)
     else:
         for i in range(n):
-            x, _ = cls(cfg, **block_kwargs, name=f"layer_{i}")(x, *extra)
+            args = extra if per_layer is None else extra + (per_layer[i],)
+            x, _ = cls(cfg, **block_kwargs, name=f"layer_{i}")(x, *args)
     return x
 
 
@@ -562,7 +596,12 @@ class CausalLM(nn.Module):
         if cfg.embed_scale:  # Gemma scales embeddings by sqrt(hidden)
             x = x * jnp.asarray(np.sqrt(cfg.hidden_size), x.dtype)
         x = constrain_activations(x)
-        x = _apply_layer_stack(cfg, x, positions, mask, decode=decode)
+        # the explicit None fills the block's kv_lengths slot so the
+        # per-layer window array (if any) lands on layer_window
+        x = _apply_layer_stack(
+            cfg, x, positions, mask, None, decode=decode,
+            per_layer=_layer_windows_array(cfg),
+        )
         x = constrain_activations(RMSNorm(cfg, name="final_norm")(x))
         # logits matmul stays in the compute dtype (bf16 on the MXU — fp32
         # here costs ~4x on the biggest matmul); the loss upcasts to fp32
@@ -580,6 +619,13 @@ class CausalLM(nn.Module):
                 ),
                 name="lm_head",
             )(x)
+        if cfg.final_softcap is not None:
+            # Gemma-2 final-logit soft-capping (in fp32: tanh saturates
+            # quickly in bf16 and the caps exist to shape the tail)
+            logits = (
+                cfg.final_softcap
+                * jnp.tanh(logits.astype(jnp.float32) / cfg.final_softcap)
+            ).astype(logits.dtype)
         return logits
 
     # ------------------------------------------------------------------ #
@@ -650,8 +696,17 @@ class SequenceClassifier(nn.Module):
 
         attn_mask4d = kv_lengths = is_prefix = None
         if attention_mask is not None:
-            use_flash = cfg.attention_impl == "flash" or (
-                cfg.attention_impl is None and flash_self_attention_eligible(s)
+            # softcap / per-layer windows force the xla path, which can
+            # consume the exact dense mask — don't lower to kv_lengths
+            # (the dispatch-must-agree contract of dot_product_attention)
+            flash_compatible = (
+                cfg.attn_softcap is None and cfg.layer_windows is None
+            )
+            use_flash = flash_compatible and (
+                cfg.attention_impl == "flash" or (
+                    cfg.attention_impl is None
+                    and flash_self_attention_eligible(s)
+                )
             )
             if use_flash:
                 keep = attention_mask > 0
@@ -665,7 +720,10 @@ class SequenceClassifier(nn.Module):
                 # (B, S) keep-mask -> (B, 1, 1, S): padded keys invisible
                 attn_mask4d = attention_mask[:, None, None, :] > 0
         x = _make_embed(cfg, dtype)(input_ids)
-        x = _apply_layer_stack(cfg, x, positions, attn_mask4d, kv_lengths)
+        x = _apply_layer_stack(
+            cfg, x, positions, attn_mask4d, kv_lengths,
+            per_layer=_layer_windows_array(cfg),
+        )
         if is_prefix is not None:
             x = jnp.where(is_prefix[:, None, None], x, jnp.nan)
         x = RMSNorm(cfg, name="final_norm")(x)

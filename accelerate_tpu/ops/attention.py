@@ -67,13 +67,17 @@ def xla_attention(
     causal: bool = False,
     kv_lengths: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Reference-path attention, shapes (B, S, H, D) / kv (B, Skv, Hkv, D).
 
     fp32 softmax regardless of input dtype (bf16-safe), GQA via kv head
     repetition (broadcast, not materialized by XLA after fusion).
     ``window`` (requires ``causal``): the Mistral/Qwen2 sliding-window
-    band — each query sees at most the last ``window`` keys.
+    band — each query sees at most the last ``window`` keys; a TRACED
+    window (the per-layer Gemma-2 pattern riding the layer scan) is fine
+    here — only this path, not flash/ring, accepts one. ``softcap``:
+    Gemma-2 tanh soft-capping of the raw scores.
     """
     if window is not None and not causal:
         raise ValueError("sliding window requires causal attention")
@@ -84,6 +88,10 @@ def xla_attention(
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
+    if softcap is not None:
+        # Gemma-2 tanh soft-capping, applied to raw scores BEFORE any
+        # masking (transformers modeling_gemma2.py eager_attention_forward)
+        logits = softcap * jnp.tanh(logits / softcap)
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
     if causal:
@@ -103,11 +111,13 @@ def xla_attention(
 
 
 def flash_self_attention_eligible(seq_len: int) -> bool:
-    """Would auto-dispatch pick the flash kernel for self-attention at this
-    sequence length (no dense mask/bias)? Mirrors the flash_ok predicate in
-    :func:`dot_product_attention`; models use it to decide whether to
-    lower a right-padded attention mask to kv_lengths (flash fast path) or
-    keep the exact dense key mask (xla path)."""
+    """Would auto-dispatch pick the flash kernel for self-attention at
+    this sequence length — the SHAPE/BACKEND part of the flash_ok
+    predicate in :func:`dot_product_attention` (callers must separately
+    rule out the flash-incompatible model switches: score soft-capping
+    and traced per-layer windows). Models use it to decide whether to
+    lower a right-padded attention mask to kv_lengths (flash fast path)
+    or keep the exact dense key mask (xla path)."""
     from .flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, fit_block
 
     return (
@@ -130,6 +140,7 @@ def dot_product_attention(
     kv_lengths: Optional[jax.Array] = None,
     implementation: Optional[str] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Attention entry point, shapes (batch, seq, heads, head_dim).
 
@@ -142,13 +153,19 @@ def dot_product_attention(
     SKIPS kv blocks entirely below the band — work scales with
     S*window, not S^2); ring attention rejects it (a band crossing ring
     shards would need per-hop bounds — use flash/xla, which at
-    window << S is the memory-frugal regime anyway).
+    window << S is the memory-frugal regime anyway). A TRACED window
+    (Gemma-2's per-layer pattern riding the layer scan) routes to xla.
+
+    ``softcap``: Gemma-2 tanh score soft-capping — xla path only (the
+    flash online-softmax backward would need the tanh chain threaded
+    through both passes).
 
     ``implementation``: None (auto) | "xla" | "flash" | "ring".
     Auto picks flash on TPU backends for causal or bidirectional
     self-attention with no custom mask/bias tensor (kv_lengths is fine —
     that's the padded-batch fast path), else xla.
     """
+    window_static = window is None or isinstance(window, int)
     if implementation is None:
         # trace-time decision: tracers have no .devices(), so the
         # eligibility helper keys off the default backend (correct under
@@ -156,6 +173,7 @@ def dot_product_attention(
         # based on flash_self_attention_eligible, so dispatch must agree.
         flash_ok = (
             bias is None and mask is None
+            and softcap is None and window_static
             and q.shape[1] == k.shape[1]
             and flash_self_attention_eligible(q.shape[1])
         )
@@ -163,7 +181,7 @@ def dot_product_attention(
     if implementation == "xla":
         return xla_attention(
             q, k, v, mask=mask, bias=bias, scale=scale, causal=causal,
-            kv_lengths=kv_lengths, window=window,
+            kv_lengths=kv_lengths, window=window, softcap=softcap,
         )
     if implementation == "flash":
         from .flash_attention import flash_attention
@@ -174,6 +192,11 @@ def dot_product_attention(
                 "right-padding via kv_lengths, or implementation='xla' for "
                 "arbitrary masks"
             )
+        if softcap is not None or not window_static:
+            raise ValueError(
+                "flash attention supports neither score soft-capping nor "
+                "traced per-layer windows — use implementation='xla'"
+            )
         return flash_attention(
             q, k, v, scale=scale, causal=causal, kv_lengths=kv_lengths,
             window=window,
@@ -183,11 +206,12 @@ def dot_product_attention(
 
         if mask is not None or bias is not None or kv_lengths is not None:
             raise ValueError("ring attention supports no custom mask/bias")
-        if window is not None:
+        if window is not None or softcap is not None:
             raise ValueError(
-                "ring attention does not support sliding windows — use "
-                "implementation='flash' or 'xla' (at window << seq the "
-                "flash band-skip already bounds memory and work)"
+                "ring attention supports neither sliding windows nor score "
+                "soft-capping — use implementation='flash' or 'xla' (at "
+                "window << seq the flash band-skip already bounds memory "
+                "and work)"
             )
         return ring_attention(q, k, v, scale=scale, causal=causal)
     raise ValueError(f"unknown attention implementation {implementation!r}")

@@ -31,9 +31,13 @@ Architectures covered: the Llama family (Llama-2/3/3.1+ incl. GQA,
 llama3/linear rope scaling, tied or untied heads), Mistral (the Llama
 layout + every-layer sliding window — ``TransformerConfig.sliding_window``
 — incl. NeMo's decoupled head_dim), Qwen2 (the Llama layout plus q/k/v
-biases — ``TransformerConfig.qkv_bias``; sliding window when every layer
-slides), Gemma v1 (offset RMSNorm / tanh-GELU gate / scaled embeddings —
-``norm_offset``/``mlp_activation``/``embed_scale``; Gemma-2/3 rejected),
+biases — ``TransformerConfig.qkv_bias``; sliding window incl. per-layer
+mixes via ``layer_windows``), Gemma v1 (offset RMSNorm / tanh-GELU gate /
+scaled embeddings — ``norm_offset``/``mlp_activation``/``embed_scale``),
+Gemma-2 (the v1 trio plus ``post_norms`` 4-norm blocks,
+``query_pre_attn_scalar``, ``attn_softcap``/``final_softcap`` tanh
+capping, and the alternating sliding/full pattern as ``layer_windows``;
+Gemma-3 rejected),
 Mixtral-style MoE (``sliding_window`` honored) — the BASELINE.md targets
 (Llama-3-8B FSDP, Mixtral 8x7B EP,
 Llama-3-70B device_map="auto") — and classic GPT-2 via the faithful
@@ -200,15 +204,27 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
     # set (modeling_mistral.py:355, modeling_mixtral.py:448); Qwen2
     # zeroes it unless use_sliding_window
     # (configuration_qwen2.py:181) and then derives per-layer layer_types
-    # with layers >= max_window_layers sliding (:204-209). The nn.scan
-    # layout compiles ONE homogeneous layer body, so all-sliding and
-    # all-full load; a genuine per-layer mix is rejected loudly.
-    sliding_window = None
+    # with layers >= max_window_layers sliding (:204-209); Gemma-2
+    # alternates sliding/full every other layer
+    # (configuration_gemma2.py:176-179). Homogeneous patterns collapse to
+    # ``sliding_window``; genuine mixes ride the scan as per-layer
+    # ``layer_windows``.
+    def _resolve_layer_types(layer_types, w):
+        kinds = set(layer_types)
+        if kinds == {"full_attention"}:
+            return None, None
+        if kinds == {"sliding_attention"}:
+            return w, None
+        return None, tuple(
+            w if t == "sliding_attention" else None for t in layer_types
+        )
+
+    sliding_window = layer_windows = None
     if model_type in ("mistral", "mixtral"):
         sliding_window = hf.get("sliding_window")
     elif model_type == "qwen2" and hf.get("use_sliding_window", False):
-        sliding_window = hf.get("sliding_window")
-        if sliding_window is not None:
+        w = hf.get("sliding_window")
+        if w is not None:
             n = hf["num_hidden_layers"]
             layer_types = hf.get("layer_types") or [
                 "sliding_attention"
@@ -216,28 +232,29 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
                 else "full_attention"
                 for i in range(n)
             ]
-            kinds = set(layer_types)
-            if kinds == {"full_attention"}:
-                sliding_window = None
-            elif kinds != {"sliding_attention"}:
-                raise ValueError(
-                    "Qwen2 checkpoints mixing sliding and full attention "
-                    f"layers (layer_types {sorted(kinds)}, max_window_layers"
-                    f"={hf.get('max_window_layers')}) are not supported: "
-                    "the nn.scan layout compiles one homogeneous layer "
-                    "body — only all-sliding or all-full loads"
-                )
-    if model_type in ("gemma2", "gemma3", "gemma3_text"):
-        # Gemma-2/3 add attention/final-logit soft-capping, pre+post
-        # norms per block and sliding-window layers — math the native
-        # model does not implement; every tensor of the shared keys
-        # would load and logits would silently diverge
+            sliding_window, layer_windows = _resolve_layer_types(
+                layer_types, w
+            )
+    elif model_type == "gemma2":
+        w = hf.get("sliding_window", 4096)
+        n = hf["num_hidden_layers"]
+        layer_types = hf.get("layer_types") or [
+            "sliding_attention" if (i + 1) % 2 else "full_attention"
+            for i in range(n)
+        ]
+        sliding_window, layer_windows = _resolve_layer_types(layer_types, w)
+    if model_type in ("gemma3", "gemma3_text"):
+        # Gemma-3 adds q/k norms and per-layer-type rope bases — math the
+        # native model does not implement; every tensor of the shared
+        # keys would load and logits would silently diverge
         raise ValueError(
-            f"HF model_type {model_type!r} is not supported: Gemma-2/3 "
-            "soft-capping/post-norms/sliding-window are not implemented "
-            "(Gemma v1 loads via model_type 'gemma')"
+            f"HF model_type {model_type!r} is not supported: Gemma-3 "
+            "qk-norms / dual rope bases are not implemented (Gemma v1 "
+            "loads via model_type 'gemma', Gemma-2 via 'gemma2')"
         )
-    if model_type not in ("llama", "mistral", "mixtral", "qwen2", "gemma"):
+    if model_type not in (
+        "llama", "mistral", "mixtral", "qwen2", "gemma", "gemma2"
+    ):
         # Phi/... share the model.layers.* key convention and every
         # config field this mapping reads, but differ in parameters the
         # plan would silently drop — loading them would succeed and
@@ -260,6 +277,7 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
         sliding_window=sliding_window,
+        layer_windows=layer_windows,
         # the Qwen2 convention: biases on q/k/v only (hard-wired in the
         # arch, not a config.json field)
         qkv_bias=model_type == "qwen2",
@@ -267,7 +285,7 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
     if model_type == "mistral" and hf.get("head_dim"):
         # Mistral-NeMo decouples head_dim from hidden/num_heads
         kw["head_dim"] = hf["head_dim"]
-    if model_type == "gemma":
+    if model_type in ("gemma", "gemma2"):
         act = hf.get("hidden_activation") or hf.get("hidden_act")
         if act not in (None, "gelu", "gelu_pytorch_tanh"):
             raise ValueError(
@@ -284,6 +302,21 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
             tie_embeddings=True,
             head_dim=hf.get("head_dim"),
         )
+    if model_type == "gemma2":
+        # Gemma-2 on top of the v1 trio: 4 norms per block, decoupled
+        # attention scale, tanh soft-capping on scores and final logits
+        # (transformers modeling_gemma2.py:185-189,566-569)
+        kw.update(
+            post_norms=True,
+            query_pre_attn_scalar=float(
+                hf.get("query_pre_attn_scalar", 256)
+            ),
+            # transformers defaults the caps to 50/30
+            # (configuration_gemma2.py:143-144) — a config.json omitting
+            # the keys still soft-caps there, so it must here too
+            attn_softcap=hf.get("attn_logit_softcapping", 50.0),
+            final_softcap=hf.get("final_logit_softcapping", 30.0),
+        )
     if hf.get("num_local_experts"):
         kw["num_experts"] = hf["num_local_experts"]
         kw["num_experts_per_tok"] = hf.get("num_experts_per_tok", 2)
@@ -297,6 +330,15 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
 _ATTN = {"q_proj": "q_proj", "k_proj": "k_proj", "v_proj": "v_proj", "o_proj": "o_proj"}
 _MLP = {"gate_proj": "gate_proj", "up_proj": "up_proj", "down_proj": "down_proj"}
 _NORMS = {"attn_norm": "input_layernorm", "mlp_norm": "post_attention_layernorm"}
+# Gemma-2's 4-norm block: HF's post_attention_layernorm is the norm AFTER
+# attention (native post_attn_norm), and the pre-MLP norm is
+# pre_feedforward_layernorm (native mlp_norm)
+_NORMS_POST = {
+    "attn_norm": "input_layernorm",
+    "post_attn_norm": "post_attention_layernorm",
+    "mlp_norm": "pre_feedforward_layernorm",
+    "post_mlp_norm": "post_feedforward_layernorm",
+}
 # Mixtral expert weights: w1 = gate, w3 = up, w2 = down (transposed)
 _MOE_EXPERT = {"gate_proj": "w1", "up_proj": "w3", "down_proj": "w2"}
 
@@ -407,9 +449,10 @@ def _plan_for(parts: tuple[str, ...], config) -> _HfPlanEntry:
             return _HfPlanEntry(
                 [f"{p}.self_attn.{_ATTN[rest[1]]}.bias" for p in prefix], 1, False
             )
-        if len(rest) == 2 and rest[0] in _NORMS and rest[1] == "scale":
+        norms = _NORMS_POST if getattr(config, "post_norms", False) else _NORMS
+        if len(rest) == 2 and rest[0] in norms and rest[1] == "scale":
             return _HfPlanEntry(
-                [f"{p}.{_NORMS[rest[0]]}.weight" for p in prefix], 1, False
+                [f"{p}.{norms[rest[0]]}.weight" for p in prefix], 1, False
             )
         if len(rest) == 3 and rest[0] == "mlp" and rest[1] in _MLP and rest[2] == "kernel":
             return _HfPlanEntry(
@@ -621,20 +664,42 @@ def _export_arch(config) -> tuple[str, str]:
         )
     qkv = getattr(config, "qkv_bias", False)
     moe = bool(config.num_experts)
+    post = getattr(config, "post_norms", False)
     sw = getattr(config, "sliding_window", None) is not None
+    lw = getattr(config, "layer_windows", None) is not None
     if sum((is_gemma, qkv, moe)) > 1:
         raise ValueError(
             "no HF model_type represents this switch combination "
             f"(gemma-math={is_gemma}, qkv_bias={qkv}, moe={moe}); "
             "save a native checkpoint instead"
         )
-    if sw and is_gemma:
+    if post and not is_gemma:
+        raise ValueError(
+            "post_norms without the Gemma math trio matches no HF "
+            "model_type; save a native checkpoint instead"
+        )
+    if (sw or lw) and is_gemma and not post:
         # GemmaConfig (v1) has no sliding_window field — transformers
-        # would drop the band silently on reload
+        # would drop the band silently on reload (Gemma-2, post_norms,
+        # DOES carry one)
         raise ValueError(
             "no HF model_type represents Gemma-v1 math with a sliding "
             "window; save a native checkpoint instead"
         )
+    if lw and not (post or qkv):
+        # only Gemma2Config/Qwen2Config carry per-layer layer_types
+        raise ValueError(
+            "no HF model_type represents per-layer windows outside the "
+            "Gemma-2/Qwen2 families; save a native checkpoint instead"
+        )
+    if lw:
+        widths = {w for w in config.layer_windows if w is not None}
+        if len(widths) > 1:
+            raise ValueError(
+                "HF configs carry ONE sliding_window; per-layer windows "
+                f"with mixed widths {sorted(widths)} cannot round-trip — "
+                "save a native checkpoint instead"
+            )
     if is_gemma and not config.tie_embeddings:
         raise ValueError(
             "Gemma checkpoints are always tied; an untied lm_head would "
@@ -643,6 +708,8 @@ def _export_arch(config) -> tuple[str, str]:
         )
     if moe:
         return "MixtralForCausalLM", "mixtral"
+    if is_gemma and post:
+        return "Gemma2ForCausalLM", "gemma2"
     if is_gemma:
         return "GemmaForCausalLM", "gemma"
     if qkv:
@@ -780,20 +847,42 @@ def save_hf_checkpoint(
     }
     if config.rope_scaling:
         hf_cfg["rope_scaling"] = config.rope_scaling
-    if mt == "gemma":
+    if mt in ("gemma", "gemma2"):
         hf_cfg["head_dim"] = config.head_dim
         hf_cfg["hidden_activation"] = "gelu_pytorch_tanh"
     sw = getattr(config, "sliding_window", None)
+    lw = getattr(config, "layer_windows", None)
+    lw_width = next((w for w in (lw or ()) if w is not None), None)
+    layer_types = (
+        ["sliding_attention" if w is not None else "full_attention"
+         for w in lw]
+        if lw is not None else None
+    )
     if mt in ("mistral", "mixtral"):
         hf_cfg["sliding_window"] = sw  # None -> full attention, HF default
         if mt == "mistral":
             hf_cfg["head_dim"] = config.head_dim
-    elif mt == "qwen2" and sw is not None:
-        # every layer slides (infer_config_from_hf round-trips this via
-        # the derived layer_types)
+    elif mt == "qwen2" and (sw is not None or lw is not None):
         hf_cfg["use_sliding_window"] = True
-        hf_cfg["sliding_window"] = sw
-        hf_cfg["max_window_layers"] = 0
+        hf_cfg["sliding_window"] = sw if sw is not None else lw_width
+        if layer_types is not None:
+            hf_cfg["layer_types"] = layer_types
+        else:
+            # every layer slides (infer_config_from_hf round-trips this
+            # via the derived layer_types)
+            hf_cfg["max_window_layers"] = 0
+    elif mt == "gemma2":
+        hf_cfg["query_pre_attn_scalar"] = config.query_pre_attn_scalar
+        hf_cfg["attn_logit_softcapping"] = config.attn_softcap
+        hf_cfg["final_logit_softcapping"] = config.final_softcap
+        if lw is not None:
+            hf_cfg["sliding_window"] = lw_width
+            hf_cfg["layer_types"] = layer_types
+        else:
+            hf_cfg["sliding_window"] = sw
+            hf_cfg["layer_types"] = [
+                "sliding_attention" if sw is not None else "full_attention"
+            ] * config.num_layers
     if config.num_experts:
         hf_cfg["num_local_experts"] = config.num_experts
         hf_cfg["num_experts_per_tok"] = config.num_experts_per_tok

@@ -685,15 +685,30 @@ def test_qwen2_sliding_window_logits_match_torch(tmp_path):
     assert infer_config_from_hf(out).sliding_window == 4
 
 
-def test_qwen2_mixed_window_layers_rejected(tmp_path):
-    """A genuine per-layer sliding/full mix cannot map onto the
-    homogeneous nn.scan layer body — reject at config time."""
-    _, path = _save_hf_qwen2(
-        tmp_path, seed=14, use_sliding_window=True, sliding_window=32,
+def test_qwen2_mixed_window_layers_load(tmp_path):
+    """A genuine per-layer sliding/full mix rides the layer scan as
+    ``layer_windows`` (r5: the traced per-layer band) — logits must match
+    transformers, which applies the window only to the sliding layers."""
+    hf_model, path = _save_hf_qwen2(
+        tmp_path, seed=14, use_sliding_window=True, sliding_window=4,
         max_window_layers=1,  # layer 0 full, layer 1 sliding
     )
-    with pytest.raises(ValueError, match="mixing sliding and full"):
-        infer_config_from_hf(path)
+    config = infer_config_from_hf(path, attention_impl="xla")
+    assert config.sliding_window is None
+    assert config.layer_windows == (None, 4)
+    params = load_checkpoint_and_dispatch(
+        _abstract(config), path, device_map={"": "cpu"}
+    )
+    ours = _native_logits(config, params, _IDS)
+    theirs = _torch_logits(hf_model, _IDS)
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+    # the mix is live: all-full logits must differ beyond tolerance
+    import dataclasses
+
+    full = _native_logits(
+        dataclasses.replace(config, layer_windows=(None, None)), params, _IDS
+    )
+    assert float(np.max(np.abs(full - ours))) > 1e-2
 
 
 def _save_hf_mistral(tmp_path, seed=15, **cfg_kw):
@@ -748,6 +763,124 @@ def test_mistral_sliding_window_logits_match_torch(tmp_path):
     np.testing.assert_allclose(
         _torch_logits(hf2, _IDS), theirs, rtol=2e-4, atol=2e-4
     )
+
+
+def _save_hf_gemma2(tmp_path, seed=21, **cfg_kw):
+    cfg = transformers.Gemma2Config(
+        vocab_size=_TINY["vocab_size"],
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        # decoupled from head_dim (16) so the scale switch is observable;
+        # production caps (50/30) are deep in tanh's linear region at toy
+        # scale, so tiny caps keep the soft-capping itself observable too
+        query_pre_attn_scalar=32.0,
+        sliding_window=4,
+        attn_logit_softcapping=1.0,
+        final_logit_softcapping=5.0,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        hidden_activation="gelu_pytorch_tanh",
+        attention_dropout=0.0,
+        **cfg_kw,
+    )
+    torch.manual_seed(seed)
+    # transformers' default sdpa path SILENTLY DROPS the attention
+    # softcap (sdpa_attention_forward has no softcap kwarg) — eager is
+    # the faithful Gemma-2 math this port implements
+    cfg._attn_implementation = "eager"
+    model = transformers.Gemma2ForCausalLM(cfg).eval()
+    # default-init scores are ~1e-3: every cap/scale switch would be
+    # numerically invisible and the logits match would prove nothing
+    # about them. Amplify q/k and the tied embedding so scores/logits sit
+    # in the caps' ACTIVE region — big enough to bend, not so big that
+    # tanh saturates every logit to the cap and greedy argmax becomes a
+    # float-noise coin flip between tied tokens.
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.self_attn.q_proj.weight *= 10.0
+            layer.self_attn.k_proj.weight *= 10.0
+        model.model.embed_tokens.weight *= 4.0
+    path = str(tmp_path / "hf_gemma2")
+    model.save_pretrained(path, safe_serialization=True)
+    return model, path
+
+
+def test_gemma2_checkpoint_logits_match_torch(tmp_path):
+    """Gemma-2 (r5: the family the r4 matrix rejected) loads with ALL its
+    math live — 4 offset-norms per block, query_pre_attn_scalar scale,
+    attn + final tanh soft-capping, and the alternating sliding/full
+    layer pattern riding the scan as a traced per-layer window — with
+    logits matching transformers."""
+    from accelerate_tpu.models import causal_model_for
+
+    hf_model, path = _save_hf_gemma2(tmp_path)
+    assert is_hf_checkpoint(path)
+    config = infer_config_from_hf(path, attention_impl="xla")
+    # default Gemma-2 pattern: layer 0 sliding, layer 1 full
+    assert config.layer_windows == (4, None)
+    assert config.post_norms and config.attn_softcap == 1.0
+    assert config.query_pre_attn_scalar == 32.0 and config.tie_embeddings
+    model = causal_model_for(config)
+    params = load_checkpoint_and_dispatch(
+        _abstract(config), path, device_map={"": "cpu"}, config=config,
+    )
+    assert "post_attn_norm" in params["layers"]
+    ours = _native_logits(config, params, _IDS)
+    theirs = _torch_logits(hf_model, _IDS)
+    np.testing.assert_allclose(ours, theirs, rtol=3e-4, atol=3e-4)
+    # every switch is live: turning each one off must move the logits
+    import dataclasses
+
+    for off in (
+        {"layer_windows": (None, None)},
+        {"attn_softcap": None},
+        {"final_softcap": None},
+        {"query_pre_attn_scalar": None},
+    ):
+        perturbed = _native_logits(
+            dataclasses.replace(config, **off), params, _IDS
+        )
+        assert float(np.max(np.abs(perturbed - ours))) > 1e-3, off
+
+    # export round-trip: transformers loads the native save as gemma2
+    out = str(tmp_path / "gemma2_export")
+    save_hf_checkpoint(params, config, out)
+    cfg_json = json.load(open(os.path.join(out, "config.json")))
+    assert cfg_json["model_type"] == "gemma2"
+    assert cfg_json["layer_types"] == ["sliding_attention", "full_attention"]
+    hf2 = transformers.Gemma2ForCausalLM.from_pretrained(
+        out, attn_implementation="eager"
+    ).eval()
+    np.testing.assert_allclose(
+        _torch_logits(hf2, _IDS), theirs, rtol=3e-4, atol=3e-4
+    )
+
+
+def test_gemma2_generate_matches_torch_greedy(tmp_path):
+    """The KV-cache decode path under per-layer windows + soft-capping
+    reproduces transformers' greedy generation token-for-token."""
+    from accelerate_tpu.models import causal_model_for
+    from accelerate_tpu.models.generation import generate
+
+    hf_model, path = _save_hf_gemma2(tmp_path, seed=22)
+    config = infer_config_from_hf(path, attention_impl="xla")
+    model = causal_model_for(config)
+    params = load_checkpoint_and_dispatch(
+        _abstract(config), path, device_map={"": "cpu"}, config=config,
+    )
+    prompt = jnp.asarray(_IDS[:, :8])
+    ours = generate(model, params, prompt, max_new_tokens=8)
+    with torch.no_grad():
+        theirs = hf_model.generate(
+            torch.from_numpy(np.asarray(prompt).copy()),
+            max_new_tokens=8, do_sample=False,
+        )
+    assert np.asarray(ours)[0, -8:].tolist() == theirs[0, -8:].tolist()
 
 
 def test_mistral_generate_matches_torch_greedy(tmp_path):
@@ -859,13 +992,14 @@ def test_gemma_checkpoint_logits_match_torch(tmp_path):
     )
 
 
-def test_gemma2_rejected(tmp_path):
-    """Gemma-2 soft-capping/post-norms are not implemented — model_type
-    gemma2 must be rejected at config time, before any tensor loads."""
+def test_gemma3_rejected(tmp_path):
+    """Gemma-3 qk-norms / dual rope bases are not implemented —
+    model_type gemma3 must be rejected at config time, before any tensor
+    loads (Gemma-2 loads since r5)."""
     _, path = _save_hf_llama(tmp_path)
     cfg_path = os.path.join(path, "config.json")
     hf_cfg = json.load(open(cfg_path))
-    hf_cfg["model_type"] = "gemma2"
+    hf_cfg["model_type"] = "gemma3"
     json.dump(hf_cfg, open(cfg_path, "w"))
-    with pytest.raises(ValueError, match="gemma2"):
+    with pytest.raises(ValueError, match="gemma3"):
         infer_config_from_hf(path)
