@@ -1,15 +1,32 @@
 # Test tiers (VERDICT r4 weak #6: the 34-min serial suite taxes every
 # iteration loop on this 1-core box).
 #
-# The big lever is the persistent XLA compilation cache tests/conftest.py
-# enables (.jax_compile_cache/): nearly all suite time is XLA:CPU
-# compiles of programs that do not change between runs, so a warm cache
-# cuts repeat full-suite runs to a fraction of the cold time. `test-fast`
-# additionally skips the @slow tier (multi-process launchers, subprocess
-# dryruns, example scripts) for the inner development loop; `test` is the
-# full gate and is what CI/judging should run.
+# Measured (r5, warm compile cache): `test` 23m03s for 334 tests;
+# `test-fast` 6m15s for 185 tests — the in-process pure-logic majority
+# (model math, kernels, interop, collectives, data/optim/checkpoint
+# plumbing). What test-fast skips is the subprocess tier: multi-process
+# launchers, example scripts, the dryrun, CLI round-trips — run `test`
+# (the full gate, unchanged) before committing.
+#
+# The tier is an explicit FILE LIST, not `-m "not slow"`: deselecting by
+# marker reorders the multiprocess tests next to each other and
+# reproducibly hangs the XLA:CPU collective rendezvous on this box
+# (observed twice: ~6% CPU, 20 threads in futex wait).
+#
+# tests/conftest.py also enables a persistent XLA compilation cache
+# (.jax_compile_cache/) for the in-process majority; `test-cold`
+# disables it when hunting compiler-level issues.
 
 PYTEST ?= python -m pytest
+
+FAST_FILES = \
+  tests/test_hf_interop.py tests/test_models.py \
+  tests/test_flash_attention.py tests/test_generation.py \
+  tests/test_operations.py tests/test_quantization.py \
+  tests/test_moe.py tests/test_accelerator.py \
+  tests/test_optimizer_scheduler.py tests/test_state.py \
+  tests/test_data_loader.py tests/test_checkpointing.py \
+  tests/test_ring_attention.py tests/test_seq2seq.py
 
 .PHONY: test test-fast test-cold
 
@@ -17,7 +34,7 @@ test:
 	$(PYTEST) tests/ -q
 
 test-fast:
-	$(PYTEST) tests/ -q -m "not slow"
+	$(PYTEST) $(FAST_FILES) -q
 
 # cache-disabled full run (compiler-issue hunting)
 test-cold:

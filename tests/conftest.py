@@ -38,6 +38,11 @@ if os.environ.get("ACCELERATE_TPU_TEST_NO_CACHE", "0") != "1":
     jax.config.update(
         "jax_persistent_cache_enable_xla_caches", "all"
     )
+    # Deliberately NOT exported to subprocess tests via env vars: a
+    # measured attempt deadlocked the multiprocess debug_launcher tier
+    # (workers contending on the cache while racing their collective
+    # rendezvous — 40 min hung at 13% CPU). Children recompile; the
+    # in-process majority hits the cache.
 
 import pytest
 
