@@ -26,7 +26,8 @@ FAST_FILES = \
   tests/test_moe.py tests/test_accelerator.py \
   tests/test_optimizer_scheduler.py tests/test_state.py \
   tests/test_data_loader.py tests/test_checkpointing.py \
-  tests/test_ring_attention.py tests/test_seq2seq.py
+  tests/test_ring_attention.py tests/test_seq2seq.py \
+  tests/test_telemetry.py
 
 .PHONY: test test-fast test-cold
 

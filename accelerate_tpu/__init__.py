@@ -27,6 +27,16 @@ from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
+from .telemetry import (
+    HeartbeatMonitor,
+    JSONLSink,
+    PrometheusTextSink,
+    RecompileDetector,
+    StepTelemetry,
+    TelemetryConfig,
+    TrackerBridgeSink,
+    scan_heartbeats,
+)
 from .utils import (
     DataLoaderConfiguration,
     DistributedType,
@@ -73,4 +83,12 @@ __all__ = [
     "ProjectConfiguration",
     "ShardingStrategy",
     "set_seed",
+    "StepTelemetry",
+    "TelemetryConfig",
+    "RecompileDetector",
+    "HeartbeatMonitor",
+    "scan_heartbeats",
+    "JSONLSink",
+    "PrometheusTextSink",
+    "TrackerBridgeSink",
 ]

@@ -58,6 +58,7 @@ from .parallel.sharding import (
 )
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
+from .telemetry import StepTelemetry, TelemetryConfig
 from .utils.dataclasses import (
     CompilePlugin,
     DataLoaderConfiguration,
@@ -104,6 +105,7 @@ class Accelerator:
         seed: int = 0,
         mixed_precision_policy: Optional[MixedPrecisionPolicy] = None,
         profile_kwargs=None,
+        telemetry: Optional[Union[bool, TelemetryConfig]] = None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(
             project_dir=project_dir
@@ -147,6 +149,12 @@ class Accelerator:
         # ProfileKwargs handler (reference kwargs_handlers ProfileKwargs);
         # None -> accelerator.profile() is a no-op unless given a dir
         self.profile_handler = profile_kwargs
+        # Step-level observability: True / TelemetryConfig enables the
+        # unified_step hooks (async-aware timing, retrace detection,
+        # heartbeat, sinks); None/False leaves a disabled handle whose
+        # hooks are no-ops — no per-step block_until_ready, no threads.
+        self.telemetry = StepTelemetry(telemetry)
+        self._built_steps = 0  # names the retrace detector per built step fn
 
     # ------------------------------------------------------------------ #
     # topology passthroughs (reference accelerator.py properties)
@@ -324,6 +332,7 @@ class Accelerator:
         self, dataloader: Any, dispatch_batches: Optional[bool] = None
     ) -> DataLoaderShard:
         if isinstance(dataloader, DataLoaderShard):
+            dataloader.telemetry = self.telemetry
             self._dataloaders.append(dataloader)
             return dataloader
         config = self.state.dataloader_config
@@ -336,6 +345,9 @@ class Accelerator:
             self.state,
             config,
         )
+        # the loader reports time the loop spent blocked on q.get() so
+        # step records separate input-starvation from compute
+        prepared.telemetry = self.telemetry
         self._dataloaders.append(prepared)
         return prepared
 
@@ -515,8 +527,19 @@ class Accelerator:
 
         donate_args = (0,) if (donate and self.compile_plugin.donate_state) else ()
         jitted = jax.jit(_step, donate_argnums=donate_args)
+        # each built step fn gets its own retrace detector: two step fns
+        # legitimately see different signatures without cross-talk warnings
+        tel_label = f"unified_step#{self._built_steps}"
+        self._built_steps += 1
 
         def step_fn(carry, batch, **kw):
+            tel = self.telemetry
+            observing = tel.enabled
+            if observing:
+                tel.begin_step()
+                # fingerprint BEFORE the call: donation invalidates the
+                # carry buffers once jitted runs
+                retraced = tel.detector(tel_label).check(carry, batch, kw)
             out = jitted(carry, batch, **kw)
             # Host mirrors, no device sync: the micro/opt progression is
             # deterministic from the call count (overflow skips hold params
@@ -525,6 +548,11 @@ class Accelerator:
             # unified_step loop (save_state then records the true step).
             self.step += 1
             self.gradient_state.sync_gradients = self.step % num_accum == 0
+            if observing:
+                tel.end_step(
+                    out, batch=batch, step=self.step, metrics=out[1],
+                    retraced=retraced, label=tel_label,
+                )
             return out
 
         step_fn.jitted = jitted  # escape hatch: no host-mirror bookkeeping
@@ -647,12 +675,24 @@ class Accelerator:
 
         donate_args = (0,) if (donate and self.compile_plugin.donate_state) else ()
         jitted = jax.jit(_step, donate_argnums=donate_args)
+        tel_label = f"unified_pipeline_step#{self._built_steps}"
+        self._built_steps += 1
 
         def step_fn(carry, x, targets):
+            tel = self.telemetry
+            observing = tel.enabled
+            if observing:
+                tel.begin_step()
+                retraced = tel.detector(tel_label).check(carry, x, targets)
             out = jitted(carry, x, targets)
             # host mirror: every pipeline step is an optimizer step
             self.step += 1
             self.gradient_state.sync_gradients = True
+            if observing:
+                tel.end_step(
+                    out, batch=x, step=self.step, metrics=out[1],
+                    retraced=retraced, label=tel_label,
+                )
             return out
 
         step_fn.jitted = jitted  # escape hatch, same as unified_step
@@ -1005,6 +1045,7 @@ class Accelerator:
     def end_training(self):
         for tracker in self.trackers:
             tracker.finish()
+        self.telemetry.close()
         self.wait_for_everyone()
 
     def __repr__(self):

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 import queue as queue_mod
 from typing import Any, Callable, Iterable, Iterator, Optional
 
@@ -305,6 +306,24 @@ class DataLoaderShard(DataLoaderStateMixin):
         self._skip_batches = _skip_batches
         self.end_of_dataloader = False
         self.remainder = -1
+        # set by Accelerator.prepare_data_loader: a StepTelemetry that gets
+        # told how long the loop blocked waiting for each batch, so step
+        # records separate input starvation from compute
+        self.telemetry = None
+
+    def _timed_get(self, q: "queue_mod.Queue") -> Any:
+        """q.get() that reports blocking time to the telemetry collector.
+
+        The producer thread prefetches, so in a healthy pipeline the queue
+        is non-empty and this is ~0; sustained dataloader_wait_s means the
+        input pipeline — not the TPU — is the bottleneck."""
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return q.get()
+        t0 = time.perf_counter()
+        item = q.get()
+        tel.record_dataloader_wait(time.perf_counter() - t0)
+        return item
 
     @property
     def total_batch_size(self) -> int:
@@ -397,11 +416,11 @@ class DataLoaderShard(DataLoaderStateMixin):
             thread = threading.Thread(target=_producer, daemon=True)
             thread.start()
 
-            current = q.get()
+            current = self._timed_get(q)
             if isinstance(current, BaseException):
                 raise current
             while current is not stop:
-                nxt = q.get()
+                nxt = self._timed_get(q)
                 if isinstance(nxt, BaseException):
                     raise nxt
                 host_batch, valid = current
@@ -464,6 +483,17 @@ class DataLoaderDispatcher(DataLoaderShard):
                     payload = [None, 0, True]
                 return broadcast_object_list(payload, from_process=0)
 
+            def _next_payload_timed():
+                # no prefetch thread on this path: the whole read+broadcast
+                # blocks the loop, so all of it is dataloader wait
+                tel = self.telemetry
+                if tel is None or not tel.enabled:
+                    return _next_payload()
+                t0 = time.perf_counter()
+                payload = _next_payload()
+                tel.record_dataloader_wait(time.perf_counter() - t0)
+                return payload
+
             def _to_batch(payload):
                 host_batch, valid, _ = payload
                 num = jax.process_count()
@@ -479,9 +509,9 @@ class DataLoaderDispatcher(DataLoaderShard):
                 return self._device_put(local_batch, valid), valid
 
             # one-payload lookahead so the last batch is marked before yield
-            current = _next_payload()
+            current = _next_payload_timed()
             while not current[2]:
-                nxt = _next_payload()
+                nxt = _next_payload_timed()
                 batch, valid = _to_batch(current)
                 if nxt[2]:
                     self.end_of_dataloader = True

@@ -57,6 +57,7 @@ class CheckpointManager:
         accelerator,
         every_n_steps: int = 500,
         handle_signals: bool = True,
+        heartbeat=None,
     ):
         if every_n_steps < 1:
             raise ValueError("every_n_steps must be >= 1")
@@ -69,6 +70,15 @@ class CheckpointManager:
             )
         self.accelerator = accelerator
         self.every_n_steps = every_n_steps
+        # optional telemetry.HeartbeatMonitor (defaults to the
+        # accelerator's, when its telemetry config enabled one): manager
+        # step() beats it, so loops driven through CheckpointManager get
+        # the hang watchdog without a second call site
+        if heartbeat is None:
+            heartbeat = getattr(
+                getattr(accelerator, "telemetry", None), "heartbeat", None
+            )
+        self.heartbeat = heartbeat
         self._count = 0
         self._preempted = threading.Event()
         self._preemption_logged = False
@@ -117,6 +127,8 @@ class CheckpointManager:
         immediately when preempted (then flags ``should_stop``). Returns
         the checkpoint dir when one was written."""
         self._count += 1
+        if self.heartbeat is not None:
+            self.heartbeat.beat(self._count)
         preempted = self.preempted
         if preempted and not self._preemption_logged:
             self._preemption_logged = True

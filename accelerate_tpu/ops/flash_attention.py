@@ -25,6 +25,7 @@ kv loop — that is what makes the accumulator pattern work.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional
 
@@ -32,6 +33,28 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+
+@contextlib.contextmanager
+def kernel_interpret_mode():
+    """Run the Pallas TPU kernels under the interpreter — same kernel
+    code, exact semantics — so CPU CI covers them without a chip. No-op
+    on a real TPU backend. Newer pallas exposes a process-wide switch
+    (``force_tpu_interpret_mode``); older pallas only has the per-call
+    ``interpret`` flag, flipped here for the duration of the context."""
+    if jax.default_backend() == "tpu":
+        yield
+        return
+    if hasattr(pltpu, "force_tpu_interpret_mode"):
+        with pltpu.force_tpu_interpret_mode():
+            yield
+        return
+    real = pl.pallas_call
+    pl.pallas_call = functools.partial(real, interpret=True)
+    try:
+        yield
+    finally:
+        pl.pallas_call = real
 
 # Measured on v5e at (B8, S1024, H32/8, D128) fwd+bwd: 1024/1024 runs ~15%
 # faster than 512/512 (fewer grid steps, better MXU occupancy); the

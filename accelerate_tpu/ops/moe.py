@@ -238,7 +238,6 @@ def moe_ragged_ep(
 
     ctx = nested_manual_mesh()
     sm_mesh = ctx if ctx is not None else mesh
-    from jax import shard_map
 
     if not ragged_ep_supported():
         # full-manual would manualize dp/fsdp too: in_specs P() for the
@@ -250,6 +249,8 @@ def moe_ragged_ep(
             "(axis_names), unavailable in this jax version — use "
             "moe_dispatch='capacity' for expert parallelism"
         )
+    # the capability check above guarantees the top-level import exists
+    from jax import shard_map
     return shard_map(
         body,
         mesh=sm_mesh,

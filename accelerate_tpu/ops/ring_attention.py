@@ -90,10 +90,13 @@ def _chunk_attend(q, k, v, scale, mode, q_index=None, kv_index=None):
 
 
 def _ring_attention_local(
-    q, k, v, *, axis_name: str, scale: float, causal: bool
+    q, k, v, *, axis_name: str, axis_size: int, scale: float, causal: bool
 ):
     """Per-device body (inside shard_map): local q stays put, k/v rotate."""
-    n = jax.lax.axis_size(axis_name)
+    # the ring length must be a static python int (it unrolls the scan
+    # permutation below); the caller reads it off the mesh rather than
+    # jax.lax.axis_size, which older jax doesn't have
+    n = axis_size
     my = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]  # chunks move to the right,
     # i.e. each device receives its left neighbour's chunk: after s steps a
@@ -187,10 +190,9 @@ def ring_attention(
 
     spec = P(batch_axes, axis_name, heads, None)
 
-    body = functools.partial(
-        _ring_attention_local, axis_name=axis_name, scale=scale, causal=causal
-    )
-    from jax import shard_map
+    # version-compat wrapper: top-level jax.shard_map on new jax,
+    # jax.experimental on old, check_rep/check_vma normalized either way
+    from ..parallel.pipeline import shard_map
 
     # sp under pp: when this runs INSIDE the pipeline's partial-manual
     # stage body (parallel/pipeline.py — pp is already Manual there), the
@@ -207,6 +209,10 @@ def ring_attention(
 
     ctx = nested_manual_mesh()
     sm_mesh = ctx if ctx is not None else mesh
+    body = functools.partial(
+        _ring_attention_local, axis_name=axis_name,
+        axis_size=sm_mesh.shape[axis_name], scale=scale, causal=causal,
+    )
 
     return shard_map(
         body, mesh=sm_mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -65,12 +65,21 @@ _REP_KWARG = (
 
 
 def shard_map(f=None, **kwargs):
-    if "check_rep" in kwargs:
-        kwargs[_REP_KWARG] = kwargs.pop("check_rep")
+    for alias in ("check_rep", "check_vma"):
+        if alias in kwargs and alias != _REP_KWARG:
+            kwargs[_REP_KWARG] = kwargs.pop(alias)
     return _shard_map(f, **kwargs) if f is not None else _shard_map(**kwargs)
 
 
 _PARTIAL_MANUAL = "axis_names" in _inspect.signature(_shard_map).parameters
+
+
+def partial_manual_supported() -> bool:
+    """True when this jax's ``shard_map`` has partial-manual mode
+    (``axis_names``) — required by :func:`pipeline_train_step` (1F1B) and
+    by any pp mesh composed with tp/sp/ep. On older jax those paths raise
+    ``NotImplementedError``; GPipe (:func:`pipeline_apply`) still works."""
+    return _PARTIAL_MANUAL
 
 from ..utils.constants import MESH_AXIS_PIPELINE
 from ..utils.dataclasses import ParallelismPlugin

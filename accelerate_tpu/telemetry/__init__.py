@@ -1,0 +1,38 @@
+"""Unified step-level telemetry for TPU-native Accelerate.
+
+The subsystem the paper's §5 observability story wires into every
+training loop for free: async-aware step timing, throughput/MFU,
+memory high-water marks, dataloader stall accounting, recompilation
+detection, a multi-host hang watchdog, and pluggable export sinks.
+
+Entry points: ``Accelerator(telemetry=True)`` (or a
+:class:`TelemetryConfig`), then ``accelerator.telemetry.summary()`` /
+``add_sink`` / the JSONL file. Everything also works standalone around
+any jitted function — see :class:`StepTelemetry`.
+"""
+
+from .collector import StepTelemetry
+from .config import TelemetryConfig
+from .heartbeat import HeartbeatMonitor, scan_heartbeats
+from .recompile import RecompileDetector, tree_fingerprint
+from .sinks import (
+    SCHEMA_VERSION,
+    JSONLSink,
+    PrometheusTextSink,
+    TelemetrySink,
+    TrackerBridgeSink,
+)
+
+__all__ = [
+    "StepTelemetry",
+    "TelemetryConfig",
+    "HeartbeatMonitor",
+    "scan_heartbeats",
+    "RecompileDetector",
+    "tree_fingerprint",
+    "SCHEMA_VERSION",
+    "TelemetrySink",
+    "JSONLSink",
+    "PrometheusTextSink",
+    "TrackerBridgeSink",
+]

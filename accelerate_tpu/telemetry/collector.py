@@ -1,0 +1,319 @@
+"""The step-level telemetry collector.
+
+The framework — not the user — owns measurement (SURVEY §5.1): the
+Accelerator routes every ``unified_step``/``unified_pipeline_step`` call
+through the hooks here, so a training loop gets wall-clock-correct step
+times under async dispatch, throughput, memory high-water marks,
+dataloader stall time, retrace warnings and a hang watchdog by passing
+``Accelerator(telemetry=True)`` — nothing else changes.
+
+The async-dispatch contract is the heart of it: a jitted step *returns*
+before the TPU finishes, so the only honest step time is
+``start -> block_until_ready(result)``. That block is also the ONLY
+device sync telemetry introduces, and only when enabled — a disabled
+collector's hooks return immediately and the loop keeps its pipelined
+overlap (acceptance: telemetry-off adds no per-step host sync).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Optional, Union
+
+import jax
+import numpy as np
+
+from ..logging import get_logger
+from ..utils.profiling import AsyncStepTimer, device_memory_stats, host_memory_rss
+from .config import TelemetryConfig
+from .heartbeat import HeartbeatMonitor
+from .recompile import RecompileDetector
+from .sinks import SCHEMA_VERSION, JSONLSink, TelemetrySink
+
+logger = get_logger(__name__)
+
+
+def _infer_tokens(batch: Any) -> Optional[int]:
+    """Default token counter: first array leaf with a sequence dim gives
+    batch x seq; fall back to the leading dim (sample count)."""
+    fallback = None
+    for leaf in jax.tree.leaves(batch):
+        shape = getattr(leaf, "shape", None)
+        if not shape:
+            continue
+        if len(shape) >= 2:
+            return int(shape[0]) * int(shape[1])
+        if fallback is None:
+            fallback = int(shape[0])
+    return fallback
+
+
+class StepTelemetry:
+    """Per-step metrics: timing, throughput, memory, stalls, retraces.
+
+    Owned by the Accelerator (``accelerator.telemetry``) but usable
+    standalone around any jitted function::
+
+        tel = StepTelemetry(TelemetryConfig(jsonl_path="metrics.jsonl"))
+        for batch in loader:
+            tel.begin_step()
+            retraced = tel.detector("step").check(batch)
+            out = step(carry, batch)
+            carry = out[0]
+            tel.end_step(out, batch=batch, step=i, retraced=retraced)
+        tel.close()
+
+    All hooks are no-ops while ``enabled`` is False (toggleable at
+    runtime). Records go to the in-memory ring (:meth:`summary`) and to
+    every attached sink; sink exceptions are caught and rate-limited so
+    observability can never take down training.
+    """
+
+    def __init__(self, config: Optional[Union[TelemetryConfig, bool]] = None):
+        if config is None or config is False:
+            config = TelemetryConfig(enabled=False)
+        elif config is True:
+            config = TelemetryConfig()
+        self.config = config
+        self.enabled = config.enabled
+        self.sinks: list[TelemetrySink] = []
+        self.records: collections.deque = collections.deque(maxlen=config.history)
+        self.heartbeat: Optional[HeartbeatMonitor] = None
+        self._detectors: dict[str, RecompileDetector] = {}
+        self._timer = AsyncStepTimer()
+        self._dl_wait = 0.0
+        self._emitted = 0
+        self._meta_written = False
+        self._sink_errors = 0
+        self._is_emitting_rank: Optional[bool] = None
+        if config.enabled and config.jsonl_path is not None:
+            self.add_sink(JSONLSink(config.jsonl_path))
+        if config.enabled and config.heartbeat:
+            self.heartbeat = HeartbeatMonitor(
+                dir=config.heartbeat_dir,
+                interval_s=config.heartbeat_interval_s,
+                stall_timeout_s=config.heartbeat_stall_timeout_s,
+            ).start()
+
+    # ------------------------------------------------------------------ #
+    # sinks
+    # ------------------------------------------------------------------ #
+    def add_sink(self, sink: TelemetrySink) -> TelemetrySink:
+        self.sinks.append(sink)
+        return sink
+
+    def _should_emit(self) -> bool:
+        if self.config.all_ranks:
+            return True
+        if self._is_emitting_rank is None:
+            try:
+                self._is_emitting_rank = jax.process_index() == 0
+            except Exception:
+                self._is_emitting_rank = True
+        return self._is_emitting_rank
+
+    def _emit(self, record: dict) -> None:
+        self.records.append(record)
+        if not self.sinks or not self._should_emit():
+            return
+        if not self._meta_written:
+            self._meta_written = True
+            self._emit_raw(self._meta_record())
+        self._emit_raw(record)
+
+    def _emit_raw(self, record: dict) -> None:
+        for sink in self.sinks:
+            try:
+                sink.emit(record)
+            except Exception as exc:
+                self._sink_errors += 1
+                if self._sink_errors <= 3:  # rate-limit: never spam the loop
+                    logger.warning(
+                        f"telemetry sink {type(sink).__name__} failed: {exc}"
+                    )
+
+    def _meta_record(self) -> dict:
+        try:
+            backend = jax.default_backend()
+            process_index = jax.process_index()
+            process_count = jax.process_count()
+            local_devices = jax.local_device_count()
+        except Exception:
+            backend, process_index, process_count, local_devices = (
+                "unknown", 0, 1, 0,
+            )
+        return {
+            "kind": "meta",
+            "schema": SCHEMA_VERSION,
+            "time_unix": time.time(),
+            "backend": backend,
+            "process_index": process_index,
+            "process_count": process_count,
+            "local_device_count": local_devices,
+        }
+
+    # ------------------------------------------------------------------ #
+    # hooks (called by the Accelerator step wrappers / dataloader)
+    # ------------------------------------------------------------------ #
+    def detector(self, name: str) -> RecompileDetector:
+        """Get-or-create the retrace detector for one compiled fn."""
+        det = self._detectors.get(name)
+        if det is None:
+            det = self._detectors[name] = RecompileDetector(name)
+        return det
+
+    def record_dataloader_wait(self, seconds: float) -> None:
+        """Accumulate host time spent blocked waiting for a batch; drained
+        into the next step record. Called by the prepared dataloader."""
+        if self.enabled:
+            self._dl_wait += seconds
+
+    def begin_step(self) -> None:
+        """Mark the host-side start of a step call."""
+        if self.enabled:
+            self._timer.start()
+
+    def end_step(
+        self,
+        result: Any = None,
+        *,
+        batch: Any = None,
+        step: Optional[int] = None,
+        metrics: Any = None,
+        retraced: bool = False,
+        label: str = "step",
+    ) -> Optional[dict]:
+        """Complete one step: block on ``result`` (the async boundary),
+        build the record, emit to sinks, beat the heartbeat. Returns the
+        record (None while disabled)."""
+        if not self.enabled:
+            return None
+        total_s, dispatch_s = self._timer.stop(result)
+        record: dict[str, Any] = {
+            "kind": "step",
+            "label": label,
+            "step": step,
+            "time_unix": time.time(),
+            "step_time_s": total_s,
+            "dispatch_s": dispatch_s,
+            "dataloader_wait_s": self._dl_wait,
+            "retraced": bool(retraced),
+            "recompiles": sum(d.retraces for d in self._detectors.values()),
+        }
+        self._dl_wait = 0.0
+
+        tokens = None
+        if batch is not None:
+            tokens_fn = self.config.tokens_fn or _infer_tokens
+            try:
+                tokens = tokens_fn(batch)
+            except Exception:
+                tokens = None
+        record["tokens"] = tokens
+        record["tokens_per_s"] = (
+            tokens / total_s if tokens and total_s > 0 else None
+        )
+        if self.config.flops_per_token is not None:
+            flops_per_s = (
+                self.config.flops_per_token * record["tokens_per_s"]
+                if record["tokens_per_s"]
+                else None
+            )
+            record["model_flops_per_s"] = flops_per_s
+            if flops_per_s and self.config.device_peak_flops:
+                try:
+                    n_dev = jax.device_count()
+                except Exception:
+                    n_dev = 1
+                record["mfu"] = flops_per_s / (
+                    self.config.device_peak_flops * n_dev
+                )
+
+        interval = self.config.memory_interval
+        if interval and self._emitted % interval == 0:
+            stats = device_memory_stats()
+            record["peak_hbm_bytes"] = stats["peak_bytes_in_use"]
+            record["hbm_bytes_in_use"] = stats["bytes_in_use"]
+            record["hbm_bytes_limit"] = stats["bytes_limit"]
+            record["host_rss_bytes"] = host_memory_rss()
+
+        if self.config.include_step_metrics and metrics is not None:
+            # the step already crossed the blocking boundary, so these 0-d
+            # reads are free (no extra sync)
+            for key, value in _scalar_items(metrics):
+                record.setdefault(key, value)
+
+        self._emitted += 1
+        self._emit(record)
+        if self.heartbeat is not None:
+            self.heartbeat.beat(step)
+        return record
+
+    # ------------------------------------------------------------------ #
+    # reporting / lifecycle
+    # ------------------------------------------------------------------ #
+    @property
+    def recompiles(self) -> int:
+        return sum(d.retraces for d in self._detectors.values())
+
+    def summary(self) -> dict[str, Any]:
+        """Aggregate stats over the in-memory record ring. Steps that
+        (re)traced are excluded from the timing stats — compile time would
+        swamp them (the StepTimer ``skip`` semantics)."""
+        steps = [r for r in self.records if r.get("kind") == "step"]
+        timed = [r["step_time_s"] for r in steps if not r.get("retraced")]
+        out: dict[str, Any] = {
+            "steps": len(steps),
+            "recompiles": self.recompiles,
+            "dataloader_wait_total_s": float(
+                sum(r.get("dataloader_wait_s") or 0.0 for r in steps)
+            ),
+        }
+        if timed:
+            arr = np.asarray(timed)
+            out.update(
+                step_time_mean_s=float(arr.mean()),
+                step_time_median_s=float(np.median(arr)),
+                step_time_p90_s=float(np.percentile(arr, 90)),
+            )
+            tps = [
+                r["tokens_per_s"]
+                for r in steps
+                if not r.get("retraced") and r.get("tokens_per_s")
+            ]
+            if tps:
+                out["tokens_per_s_mean"] = float(np.mean(tps))
+        if self.heartbeat is not None:
+            out["stalls"] = self.heartbeat.stalls
+        return out
+
+    def close(self) -> None:
+        """Stop the watchdog and close every sink (idempotent)."""
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception as exc:
+                logger.warning(
+                    f"telemetry sink {type(sink).__name__} close failed: {exc}"
+                )
+
+
+def _scalar_items(metrics: Any):
+    """Yield (key, float) for 0-d numeric leaves of a metrics mapping."""
+    if not isinstance(metrics, dict):
+        return
+    for key, value in metrics.items():
+        if isinstance(value, (bool, str)):
+            continue
+        if isinstance(value, (int, float)):
+            yield key, float(value)
+            continue
+        shape = getattr(value, "shape", None)
+        if shape == ():
+            try:
+                yield key, float(np.asarray(value))
+            except (TypeError, ValueError):
+                continue

@@ -1,0 +1,80 @@
+"""Telemetry configuration.
+
+One dataclass controls the whole subsystem so ``Accelerator(telemetry=...)``
+stays a single argument: pass ``True`` for defaults, a
+:class:`TelemetryConfig` to tune, or leave ``None``/``False`` for a
+zero-overhead disabled handle (no per-step host sync, no threads).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class TelemetryConfig:
+    """Knobs for :class:`~accelerate_tpu.telemetry.StepTelemetry`.
+
+    ``enabled``: master switch. A disabled collector's hooks are no-ops —
+    in particular the step wrapper never calls ``block_until_ready``, so
+    async dispatch is untouched.
+
+    ``jsonl_path``: convenience — attach a
+    :class:`~accelerate_tpu.telemetry.JSONLSink` writing one record per
+    step to this path (main process only unless ``all_ranks``).
+
+    ``memory_interval``: sample peak HBM (``device_memory_stats``) and
+    host RSS every N steps; ``1`` = every step (default), ``0`` disables
+    memory sampling. The probes are host-local reads, not device syncs,
+    but on very fast steps a coarser cadence keeps the hot loop clean.
+
+    ``tokens_fn``: ``batch -> int`` token counter for throughput. When
+    None, the first array leaf with ``ndim >= 2`` supplies
+    ``shape[0] * shape[1]`` (batch x seq), falling back to the leading
+    dim — right for token models, override for anything else.
+
+    ``flops_per_token``: model FLOPs per token (≈ ``6 * n_params`` for a
+    dense transformer fwd+bwd). When set, records carry
+    ``model_flops_per_s``; with ``device_peak_flops`` (per-device, e.g.
+    197e12 for a v5p chip at bf16) they also carry MFU.
+
+    ``include_step_metrics``: copy 0-d numeric leaves of the step's
+    metrics dict (loss, grad_norm, ...) into the record — free, the
+    record is built after the blocking boundary.
+
+    ``history``: how many records to keep in memory for
+    :meth:`StepTelemetry.summary` (ring buffer; sinks see every record).
+
+    ``heartbeat``: start the :class:`HeartbeatMonitor` hang watchdog.
+    ``heartbeat_dir`` additionally writes per-rank ``heartbeat-rank*.json``
+    files (point it at shared storage to spot a stalled rank from rank 0
+    via :func:`scan_heartbeats` before the job wall clock kills everyone).
+
+    ``all_ranks``: emit records to sinks on every process instead of the
+    main process only (sinks must use per-rank paths).
+    """
+
+    enabled: bool = True
+    jsonl_path: Optional[str] = None
+    memory_interval: int = 1
+    tokens_fn: Optional[Callable[[Any], Optional[int]]] = None
+    flops_per_token: Optional[float] = None
+    device_peak_flops: Optional[float] = None
+    include_step_metrics: bool = True
+    history: int = 1024
+    heartbeat: bool = False
+    heartbeat_dir: Optional[str] = None
+    heartbeat_interval_s: float = 10.0
+    heartbeat_stall_timeout_s: float = 300.0
+    all_ranks: bool = False
+
+    def __post_init__(self):
+        if self.memory_interval < 0:
+            raise ValueError("memory_interval must be >= 0")
+        if self.history < 1:
+            raise ValueError("history must be >= 1")
+        if self.heartbeat_dir is not None:
+            # a dir implies the watchdog: writing rank files without the
+            # monitor thread would leave them permanently stale
+            self.heartbeat = True

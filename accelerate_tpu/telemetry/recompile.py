@@ -1,0 +1,119 @@
+"""Recompilation (retrace) detection.
+
+The canonical silent TPU perf killer: a batch whose shape or dtype drifts
+(ragged tail batch, a dataloader that forgot to pad, an eval loop with a
+different sequence length) makes XLA recompile the step — tens of seconds
+to minutes each time — with no signal beyond the step mysteriously taking
+forever. :class:`RecompileDetector` fingerprints the *abstract* values
+(shape + dtype per leaf, never data) of every call and mirrors jit's cache
+semantics: a fingerprint seen before is a cache hit, a new one beyond the
+first is a retrace and logs a loud warning with the exact shape diff.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+# leaves without shape/dtype (python scalars etc.) are committed to a
+# weak-typed aval by jit; only their *type* affects the trace
+_TYPE_ONLY = object()
+
+
+def tree_fingerprint(*trees: Any) -> tuple:
+    """Abstract fingerprint of pytrees: ``(path, shape, dtype)`` per leaf.
+
+    Hashable, data-free, and cheap (a host-side tree walk — no device
+    sync). Two calls with equal fingerprints hit the same jit cache entry;
+    differing fingerprints force a retrace.
+    """
+    out = []
+    for i, tree in enumerate(trees):
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        for path, leaf in flat:
+            key = f"arg{i}{jax.tree_util.keystr(path)}"
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                out.append((key, tuple(leaf.shape), str(leaf.dtype)))
+            else:
+                out.append((key, _TYPE_ONLY, type(leaf).__name__))
+    return tuple(out)
+
+
+def _diff_fingerprints(old: tuple, new: tuple) -> str:
+    """Human-readable diff naming the changed dimensions."""
+    old_map = {k: (s, d) for k, s, d in old}
+    new_map = {k: (s, d) for k, s, d in new}
+    lines = []
+    for key, (shape, dtype) in new_map.items():
+        if key not in old_map:
+            lines.append(f"{key}: new input {shape} {dtype}")
+            continue
+        oshape, odtype = old_map[key]
+        if oshape == shape and odtype == dtype:
+            continue
+        if oshape is _TYPE_ONLY or shape is _TYPE_ONLY:
+            lines.append(f"{key}: {odtype} -> {dtype}")
+            continue
+        msg = f"{key}: shape {oshape} -> {shape}"
+        if len(oshape) == len(shape):
+            dims = [
+                f"dim {i}: {a} -> {b}"
+                for i, (a, b) in enumerate(zip(oshape, shape))
+                if a != b
+            ]
+            if dims:
+                msg += " (" + ", ".join(dims) + ")"
+        if odtype != dtype:
+            msg += f", dtype {odtype} -> {dtype}"
+        lines.append(msg)
+    for key in old_map:
+        if key not in new_map:
+            lines.append(f"{key}: input removed")
+    return "; ".join(lines) or "argument tree structure changed"
+
+
+class RecompileDetector:
+    """Track the abstract input signatures a compiled function has seen.
+
+    ``check(*trees)`` returns True when this call traces (first compile or
+    retrace); retraces additionally log a WARNING with the shape diff
+    against the previous call's signature. The seen-set mirrors jit's
+    compilation cache, so flipping back to an already-compiled shape is
+    (correctly) silent.
+    """
+
+    def __init__(self, name: str, max_signatures: int = 128):
+        self.name = name
+        self.max_signatures = max_signatures
+        self.retraces = 0  # new signatures beyond the first compile
+        self._seen: set = set()
+        self._last: Optional[tuple] = None
+
+    def check(self, *trees: Any) -> bool:
+        fp = tree_fingerprint(*trees)
+        if fp in self._seen:
+            self._last = fp
+            return False
+        first = not self._seen
+        if len(self._seen) < self.max_signatures:
+            # bounded: a pathologically shape-unstable loop must not leak
+            # one fingerprint tuple per step forever (jit has the same
+            # problem with its cache — by then the warnings have fired)
+            self._seen.add(fp)
+        if not first:
+            self.retraces += 1
+            logger.warning(
+                "recompilation #%d of %s: input shapes/dtypes changed — "
+                "XLA is retracing (the silent TPU perf killer; pad inputs "
+                "to static shapes). %s",
+                self.retraces,
+                self.name,
+                _diff_fingerprints(self._last, fp),
+            )
+        self._last = fp
+        return True

@@ -1,0 +1,172 @@
+"""Pluggable telemetry export sinks.
+
+A sink receives every step record (a flat-ish JSON-able dict, schema
+below) and ships it somewhere: a JSONL file, a Prometheus textfile, an
+experiment tracker. Sinks must never take down training — the collector
+catches and rate-limits their errors.
+
+JSONL record schema (one object per line; ``kind`` discriminates):
+
+``kind="meta"`` (first line): ``schema``, ``time_unix``, ``backend``,
+``process_index``, ``process_count``, ``local_device_count``.
+
+``kind="step"`` (one per completed step)::
+
+    step               int    optimizer-step counter (host mirror)
+    label              str    which step fn ("unified_step#0", ...)
+    time_unix          float  wall-clock at record creation
+    step_time_s        float  dispatch->block_until_ready wall time
+    dispatch_s         float  host-side enqueue time (async health:
+                              dispatch_s << step_time_s is the good regime)
+    dataloader_wait_s  float  time the loop blocked waiting for a batch
+                              since the previous record
+    tokens             int?   tokens in the batch (tokens_fn / inferred)
+    tokens_per_s       float? tokens / step_time_s
+    model_flops_per_s  float? flops_per_token * tokens_per_s (if configured)
+    mfu                float? model_flops_per_s / (device_peak_flops * n_dev)
+    peak_hbm_bytes     int    device 0 lifetime peak HBM (memory_interval)
+    hbm_bytes_in_use   int    device 0 live HBM
+    hbm_bytes_limit    int    device 0 capacity (0 when unreported, e.g. CPU)
+    host_rss_bytes     int    current process RSS
+    retraced           bool   this call (re)compiled (first compile included)
+    recompiles         int    cumulative retraces beyond first compiles
+    loss/grad_norm/... float  0-d numeric step metrics (include_step_metrics)
+
+Fields marked ``?`` are null when not derivable; memory fields are absent
+on steps skipped by ``memory_interval``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Iterable, Optional, Union
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+SCHEMA_VERSION = 1
+
+
+class TelemetrySink:
+    """Base class: implement ``emit``; ``close`` if you hold resources."""
+
+    def emit(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class JSONLSink(TelemetrySink):
+    """Zero-dependency append-only JSONL file, flushed per record so a
+    killed job keeps every completed step (the bench/driver-timeout
+    lesson). Greppable, rsyncable off a pod, ``pandas.read_json(...,
+    lines=True)``-able."""
+
+    def __init__(self, path: Union[str, os.PathLike]):
+        self.path = os.fspath(path)
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._file = open(self.path, "a", buffering=1)
+
+    def emit(self, record: dict) -> None:
+        self._file.write(json.dumps(record, default=str) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        if not self._file.closed:
+            self._file.close()
+
+
+# metric-name map for the Prometheus dump: seconds get proper unit names
+_PROM_RENAMES = {
+    "step_time_s": "step_time_seconds",
+    "dispatch_s": "dispatch_seconds",
+    "dataloader_wait_s": "dataloader_wait_seconds",
+    "tokens_per_s": "tokens_per_second",
+    "time_unix": None,  # redundant with the scrape timestamp
+    "schema": None,
+}
+
+
+class PrometheusTextSink(TelemetrySink):
+    """Latest-value gauges in Prometheus text exposition format, written
+    atomically to ``path`` on every record — point node_exporter's
+    textfile collector (or a sidecar cat) at it. No client library, no
+    daemon: the step loop is the exporter."""
+
+    def __init__(self, path: Union[str, os.PathLike], prefix: str = "accelerate_tpu"):
+        self.path = os.fspath(path)
+        self.prefix = prefix
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._gauges: dict[tuple[str, str], float] = {}  # (metric, label) -> value
+
+    def emit(self, record: dict) -> None:
+        if record.get("kind") not in (None, "step"):
+            return
+        label = str(record.get("label", "step"))
+        for key, value in record.items():
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            name = _PROM_RENAMES.get(key, key)
+            if name is None:
+                continue
+            self._gauges[(f"{self.prefix}_{name}", label)] = float(value)
+        self._write()
+
+    def _write(self) -> None:
+        lines = []
+        for metric in sorted({m for m, _ in self._gauges}):
+            lines.append(f"# TYPE {metric} gauge")
+            for (m, label), value in sorted(self._gauges.items()):
+                if m == metric:
+                    lines.append(f'{metric}{{label="{label}"}} {value}')
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        os.replace(tmp, self.path)  # scrapers never see a torn file
+
+    def close(self) -> None:
+        if self._gauges:
+            self._write()
+
+
+class TrackerBridgeSink(TelemetrySink):
+    """Forward numeric record fields to ``tracking.py`` trackers
+    (``tracker.log({prefix+k: v}, step=...)``) — any of the 8 backends
+    (wandb/tensorboard/mlflow/...) becomes a telemetry sink. Pass the
+    tracker list (e.g. ``accelerator.trackers``) or an object exposing
+    ``.trackers`` (the Accelerator itself, resolved lazily so the bridge
+    can be attached before ``init_trackers``)."""
+
+    def __init__(self, trackers: Any, prefix: str = "telemetry/"):
+        self._source = trackers
+        self.prefix = prefix
+
+    def _trackers(self) -> Iterable[Any]:
+        src = self._source
+        if hasattr(src, "trackers"):
+            return src.trackers
+        return src
+
+    def emit(self, record: dict) -> None:
+        if record.get("kind") not in (None, "step"):
+            return
+        values = {
+            f"{self.prefix}{k}": v
+            for k, v in record.items()
+            if not isinstance(v, bool)
+            and isinstance(v, (int, float))
+            and k not in ("step", "time_unix", "schema")
+        }
+        if not values:
+            return
+        step = record.get("step")
+        for tracker in self._trackers():
+            tracker.log(values, step=step)

@@ -512,6 +512,28 @@ def filter_trackers(
     return loggers
 
 
+def telemetry_bridge(trackers: Any, prefix: str = "telemetry/"):
+    """Bridge step telemetry into these trackers.
+
+    Returns a :class:`~accelerate_tpu.telemetry.TrackerBridgeSink` that
+    forwards every numeric field of each step record (step time, tokens/s,
+    HBM peak, dataloader wait, loss, ...) to ``tracker.log`` under
+    ``prefix`` — so any of the tracking backends doubles as a telemetry
+    dashboard::
+
+        accelerator.telemetry.add_sink(telemetry_bridge(accelerator))
+
+    ``trackers``: a tracker list or anything exposing ``.trackers`` (the
+    Accelerator itself — resolved lazily, so the bridge may be attached
+    before ``init_trackers``).
+    """
+    # lazy import: tracking must stay importable without the telemetry
+    # package and vice versa (telemetry.sinks duck-types trackers)
+    from .telemetry import TrackerBridgeSink
+
+    return TrackerBridgeSink(trackers, prefix=prefix)
+
+
 def _flatten_config(values: dict, prefix: str = "") -> dict:
     out = {}
     for k, v in values.items():

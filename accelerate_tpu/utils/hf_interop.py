@@ -213,6 +213,15 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
         kinds = set(layer_types)
         if kinds == {"full_attention"}:
             return None, None
+        if w is None:
+            # a null/absent band with sliding layers declared would load
+            # every tensor and silently run full attention — same loud-
+            # rejection class as the semantics-changing fields above
+            raise ValueError(
+                "layer_types declares 'sliding_attention' layers but "
+                "config sliding_window is null/absent; refusing to load "
+                "the checkpoint as full attention"
+            )
         if kinds == {"sliding_attention"}:
             return w, None
         return None, tuple(
@@ -224,14 +233,16 @@ def infer_config_from_hf(checkpoint: str, **overrides) -> "Any":
         sliding_window = hf.get("sliding_window")
     elif model_type == "qwen2" and hf.get("use_sliding_window", False):
         w = hf.get("sliding_window")
-        if w is not None:
+        layer_types = hf.get("layer_types")
+        if layer_types is None and w is not None:
             n = hf["num_hidden_layers"]
-            layer_types = hf.get("layer_types") or [
+            layer_types = [
                 "sliding_attention"
                 if i >= hf.get("max_window_layers", 28)
                 else "full_attention"
                 for i in range(n)
             ]
+        if layer_types is not None:
             sliding_window, layer_windows = _resolve_layer_types(
                 layer_types, w
             )

@@ -210,6 +210,48 @@ class StepTimer:
         }
 
 
+class AsyncStepTimer:
+    """Single-step timer that separates *dispatch* from *device* time.
+
+    JAX returns from a jitted call as soon as the XLA program is enqueued;
+    the wall time of the call alone measures Python + dispatch overhead,
+    not the step. One bracket is::
+
+        timer.start()          # before the step call
+        out = step(...)        # returns immediately (async dispatch)
+        total, dispatch = timer.stop(out)   # blocks on out
+
+    ``total`` charges the full device execution to the step (the
+    ``block_until_ready`` boundary); ``dispatch`` is the host-side cost of
+    getting the program enqueued. ``dispatch ≈ total`` means the host is
+    the bottleneck (Python overhead or an already-synced result);
+    ``dispatch << total`` is the healthy async regime. Used by
+    ``telemetry.StepTelemetry`` for per-step records; :class:`StepTimer`
+    remains the aggregate-stats tool.
+    """
+
+    def __init__(self):
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    @property
+    def started(self) -> bool:
+        return self._t0 is not None
+
+    def stop(self, result: Any = None) -> tuple[float, float]:
+        """Returns ``(total_s, dispatch_s)``; blocks on ``result``."""
+        if self._t0 is None:
+            return 0.0, 0.0
+        dispatch = time.perf_counter() - self._t0
+        if result is not None:
+            jax.block_until_ready(result)
+        total = time.perf_counter() - self._t0
+        self._t0 = None
+        return total, dispatch
+
+
 # ---------------------------------------------------------------------- #
 # the XLA profiler
 # ---------------------------------------------------------------------- #

@@ -547,22 +547,23 @@ def main():
         rec = None
         first_rec = None
         err = None
+        # decode_load moves ~11 GiB across the ~0.03 GiB/s axon tunnel —
+        # genuinely slow, not hung
+        budget_s = 1800 if name == "decode_load" else 900
         for attempt in range(2):
             try:
                 proc = subprocess.run(
                     [sys.executable, __file__, name], text=True,
                     capture_output=True,
-                    # decode_load moves ~11 GiB across the ~0.03 GiB/s
-                    # axon tunnel — genuinely slow, not hung
-                    timeout=1800 if name == "decode_load" else 900,
+                    timeout=budget_s,
                 )
             except subprocess.TimeoutExpired:
                 # discard any implausible first-attempt record too — never
                 # publish a known-bad measurement alongside an error. A
-                # timeout is NOT retried: another 900s would risk the
+                # timeout is NOT retried: another budget_s would risk the
                 # driver's wall-clock window.
                 rec = None
-                err = "timeout after 900s"
+                err = f"timeout after {budget_s}s"
                 break
             line = next(
                 (l for l in proc.stdout.splitlines() if l.startswith("{")), None
@@ -610,8 +611,20 @@ def main():
                     rec = first_rec
                 rec["extra"]["retried"] = True
             results[name] = rec
+            # Emit the record the moment the variant lands, flushed, so a
+            # driver wall-clock kill cannot discard completed measurements
+            # (BENCH_r05 was rc=124 with an empty tail). The consolidated
+            # block below re-prints the FINAL (folded) records with dense
+            # last — consumers of the whole stream skip provisional lines,
+            # the parse-the-last-line driver never sees them on a clean run.
+            print(json.dumps({**rec, "provisional": True}), flush=True)
         else:
             errors[name] = err or "no output"
+            print(
+                f"bench variant {name} failed (provisional): "
+                f"{errors[name][:160]}",
+                file=sys.stderr, flush=True,
+            )
     # fold the load-time helper into the decode line (never the reverse:
     # a failed load leaves the decode headline intact with load_s null)
     if "decode" in results:

