@@ -27,9 +27,9 @@ FAST_FILES = \
   tests/test_optimizer_scheduler.py tests/test_state.py \
   tests/test_data_loader.py tests/test_checkpointing.py \
   tests/test_ring_attention.py tests/test_seq2seq.py \
-  tests/test_telemetry.py
+  tests/test_telemetry.py tests/test_compilation.py
 
-.PHONY: test test-fast test-cold
+.PHONY: test test-fast test-cold compile-cache-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -40,3 +40,11 @@ test-fast:
 # cache-disabled full run (compiler-issue hunting)
 test-cold:
 	ACCELERATE_TPU_TEST_NO_CACHE=1 $(PYTEST) tests/ -q
+
+# tiny end-to-end check of the compilation subsystem: AOT warmup compiles
+# the real unified_step with zero first-step retraces, and a persistent
+# cache dir round-trips to a recorded hit
+compile-cache-smoke:
+	$(PYTEST) -q \
+	  tests/test_compilation.py::test_warmup_then_first_step_never_retraces \
+	  tests/test_compilation.py::test_persistent_cache_round_trip_records_hit

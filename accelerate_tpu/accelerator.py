@@ -117,18 +117,29 @@ class Accelerator:
             )
         if dataloader_config is None:
             dataloader_config = DataLoaderConfiguration(split_batches=split_batches)
+        self.compile_plugin = compile_plugin or CompilePlugin()
         self.state = AcceleratorState(
             mixed_precision=mixed_precision,
             cpu=cpu,
             parallelism_plugin=parallelism_plugin,
             gradient_accumulation_plugin=gradient_accumulation_plugin,
             dataloader_config=dataloader_config,
+            compile_plugin=self.compile_plugin,
         )
         if mixed_precision_policy is not None:
             # GradScalerKwargs/AutocastKwargs parity: explicit policy override
             self.state.mixed_precision_policy = mixed_precision_policy
+        if self.compile_plugin.cache_dir and not getattr(
+            self.state, "compile_cache_dir", None
+        ):
+            # the singleton state predates this Accelerator (built by an
+            # earlier plugin-less one): activate directly — idempotent
+            from .compilation import activate_persistent_cache
+
+            self.state.compile_cache_dir = activate_persistent_cache(
+                self.compile_plugin
+            )
         self.gradient_state = GradientState(gradient_accumulation_plugin)
-        self.compile_plugin = compile_plugin or CompilePlugin()
         self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
         self.device_placement = device_placement
         self.rng_types = rng_types or ["generator"]
@@ -526,37 +537,17 @@ class Accelerator:
             return new_carry, metrics
 
         donate_args = (0,) if (donate and self.compile_plugin.donate_state) else ()
-        jitted = jax.jit(_step, donate_argnums=donate_args)
+        static_names = tuple(self.compile_plugin.static_argnames)
+        jitted = jax.jit(
+            _step,
+            donate_argnums=donate_args,
+            static_argnames=static_names or None,
+        )
         # each built step fn gets its own retrace detector: two step fns
         # legitimately see different signatures without cross-talk warnings
         tel_label = f"unified_step#{self._built_steps}"
         self._built_steps += 1
-
-        def step_fn(carry, batch, **kw):
-            tel = self.telemetry
-            observing = tel.enabled
-            if observing:
-                tel.begin_step()
-                # fingerprint BEFORE the call: donation invalidates the
-                # carry buffers once jitted runs
-                retraced = tel.detector(tel_label).check(carry, batch, kw)
-            out = jitted(carry, batch, **kw)
-            # Host mirrors, no device sync: the micro/opt progression is
-            # deterministic from the call count (overflow skips hold params
-            # but still advance the counters), so accelerator.step,
-            # sync_gradients and the schedulers stay correct in a
-            # unified_step loop (save_state then records the true step).
-            self.step += 1
-            self.gradient_state.sync_gradients = self.step % num_accum == 0
-            if observing:
-                tel.end_step(
-                    out, batch=batch, step=self.step, metrics=out[1],
-                    retraced=retraced, label=tel_label,
-                )
-            return out
-
-        step_fn.jitted = jitted  # escape hatch: no host-mirror bookkeeping
-        return step_fn
+        return self._wrap_step(jitted, tel_label, sync_every=num_accum)
 
     def unified_pipeline_step(
         self,
@@ -677,26 +668,155 @@ class Accelerator:
         jitted = jax.jit(_step, donate_argnums=donate_args)
         tel_label = f"unified_pipeline_step#{self._built_steps}"
         self._built_steps += 1
+        # every pipeline step is an optimizer step -> sync_every=1
+        return self._wrap_step(jitted, tel_label, sync_every=1)
 
-        def step_fn(carry, x, targets):
+    def _wrap_step(self, jitted, tel_label: str, *, sync_every: int) -> Callable:
+        """The shared step-fn wrapper: host-mirror bookkeeping, telemetry,
+        compile-cost attribution, and the AOT warmup fast path.
+
+        ``step_fn.warm(*specs, **kw)`` lowers and compiles ahead of time
+        (``CompilePlugin.compiler_options`` threaded into
+        ``.lower().compile(...)``), pre-seeds the retrace detector, and
+        registers the compiled executable; a later call whose abstract
+        signature matches dispatches straight to it — the first real step
+        neither traces nor compiles.
+        """
+        from .compilation import get_compile_monitor
+        from .compilation.warmup import batch_spec_of, spec_like, warm_step
+        from .telemetry.recompile import tree_fingerprint
+
+        static_names = tuple(self.compile_plugin.static_argnames)
+        mon = get_compile_monitor()
+        aot: dict[tuple, Any] = {}  # (fingerprint, statics) -> Compiled
+
+        def _aot_key(args, kw) -> tuple:
+            # statics select the traced program, so they key the executable
+            # by VALUE; the fingerprint covers everything else abstractly
+            statics = tuple(
+                sorted((k, repr(v)) for k, v in kw.items() if k in static_names)
+            )
+            return (tree_fingerprint(*args, kw), statics)
+
+        def step_fn(*args, **kw):
             tel = self.telemetry
             observing = tel.enabled
             if observing:
                 tel.begin_step()
-                retraced = tel.detector(tel_label).check(carry, x, targets)
-            out = jitted(carry, x, targets)
-            # host mirror: every pipeline step is an optimizer step
+                # fingerprint BEFORE the call: donation invalidates the
+                # carry buffers once the compiled program runs
+                retraced = tel.detector(tel_label).check(*args, kw)
+            compiled = aot.get(_aot_key(args, kw)) if aot else None
+            before = mon.snapshot() if observing else None
+            with mon.label(tel_label):
+                if compiled is not None:
+                    try:
+                        dyn_kw = {
+                            k: v for k, v in kw.items() if k not in static_names
+                        }
+                        out = compiled(*args, **dyn_kw)
+                    except Exception:
+                        # donated args are consumed only on successful
+                        # dispatch, so the jitted retry sees live buffers
+                        logger.warning(
+                            "AOT executable for %s rejected the call; "
+                            "falling back to jit dispatch", tel_label,
+                        )
+                        aot.clear()
+                        out = jitted(*args, **kw)
+                else:
+                    out = jitted(*args, **kw)
+            # Host mirrors, no device sync: the micro/opt progression is
+            # deterministic from the call count (overflow skips hold params
+            # but still advance the counters), so accelerator.step,
+            # sync_gradients and the schedulers stay correct in a
+            # unified_step loop (save_state then records the true step).
             self.step += 1
-            self.gradient_state.sync_gradients = True
+            self.gradient_state.sync_gradients = self.step % sync_every == 0
             if observing:
+                delta = mon.delta(before)
+                compiled_now = (
+                    delta.get("compile_time_s")
+                    or delta.get("persistent_cache_hits")
+                    or delta.get("persistent_cache_misses")
+                )
                 tel.end_step(
-                    out, batch=x, step=self.step, metrics=out[1],
+                    out, batch=args[1] if len(args) > 1 else None,
+                    step=self.step, metrics=out[1],
                     retraced=retraced, label=tel_label,
+                    compile_stats=delta if (retraced or compiled_now) else None,
                 )
             return out
 
-        step_fn.jitted = jitted  # escape hatch, same as unified_step
+        def warm(*args, **kw):
+            """AOT-compile this step from abstract specs.
+
+            ``args`` mirror the call signature (carry first); each may be
+            a concrete pytree (abstracted leaf-by-leaf, shardings kept),
+            a ``ShapeDtypeStruct`` pytree, or a prepared
+            ``DataLoaderShard`` (its fixed padded global batch shape is
+            used). ``kw`` must hold the same values the real calls will
+            pass. Returns the warmup record dict.
+            """
+            specs = tuple(batch_spec_of(a) for a in args)
+            static_kw = {k: v for k, v in kw.items() if k in static_names}
+            traced_kw = {k: v for k, v in kw.items() if k not in static_names}
+            before = mon.snapshot()
+            with mon.label(tel_label):
+                compiled, seconds = warm_step(
+                    jitted,
+                    *specs,
+                    static_kwargs=static_kw,
+                    traced_kwargs=traced_kw,
+                    compiler_options=self.compile_plugin.compiler_options,
+                )
+            delta = mon.delta(before)
+            warm_kw = dict(static_kw)
+            warm_kw.update(spec_like(traced_kw))
+            aot[_aot_key(specs, warm_kw)] = compiled
+            # pre-seed the retrace detector: the first real step with
+            # these shapes is a warm cache hit, not a (re)trace
+            self.telemetry.detector(tel_label).check(*specs, warm_kw)
+            record = {
+                "label": tel_label,
+                "compile_time_s": seconds,
+                "persistent_cache_hits": int(delta.get("persistent_cache_hits", 0)),
+                "persistent_cache_misses": int(
+                    delta.get("persistent_cache_misses", 0)
+                ),
+                "backend_compile_s": delta.get("compile_time_s", 0.0),
+            }
+            self.telemetry.record_compile(source="warmup", **record)
+            return record
+
+        step_fn.jitted = jitted  # escape hatch: no host-mirror bookkeeping
+        step_fn.warm = warm
+        step_fn.label = tel_label
         return step_fn
+
+    def warmup(self, step_fn: Callable, *args, **kw) -> dict:
+        """Ahead-of-time compile a built step fn: derive abstract specs
+        from ``args`` (carry / batch pytrees, or a prepared dataloader for
+        the batch seat), lower + compile with the plugin's
+        ``compiler_options``, and register the executable so the first
+        real step dispatches without tracing or compiling::
+
+            step = accelerator.unified_step(loss_fn)
+            carry = accelerator.init_carry(params)
+            accelerator.warmup(step, carry, train_loader)  # overlaps input warmup
+            for batch in train_loader:
+                carry, metrics = step(carry, batch)        # no first-step spike
+
+        Returns the warmup record (compile seconds, persistent-cache
+        hit/miss counts).
+        """
+        warm = getattr(step_fn, "warm", None)
+        if warm is None:
+            raise TypeError(
+                "warmup() needs a step built by unified_step / "
+                "unified_pipeline_step (got a bare callable)"
+            )
+        return warm(*args, **kw)
 
     def init_carry(
         self, params: Any, optimizer: Optional[AcceleratedOptimizer] = None

@@ -1,0 +1,40 @@
+"""The compilation subsystem: compile as a first-class, cached,
+observable phase.
+
+XLA always compiles; untuned, it compiles *repeatedly* — every process,
+every restart, every bench variant pays the full lowering + backend
+compile again. Production JAX trainers (MaxText/T5X-style AOT compile,
+JAX's persistent compilation cache) treat compile as a cached, warmed,
+measured resource. This package gives the Accelerator the same three
+levers:
+
+* :mod:`cache` — activate JAX's persistent compilation cache from
+  ``CompilePlugin.cache_dir`` (env: ``ACCELERATE_TPU_COMPILE_CACHE``),
+  so identical programs compile once per *cache*, not once per process;
+* :mod:`monitor` — attribute compile cost: per-step-fn compile seconds
+  and persistent-cache hit/miss counts, collected from
+  ``jax.monitoring`` events and exposed to the telemetry sinks;
+* :mod:`warmup` — ahead-of-time lower+compile a built step fn from
+  ``ShapeDtypeStruct`` specs (derived from the prepared dataloader's
+  fixed padded batch shape), so host data loading and XLA compilation
+  overlap instead of serialize.
+"""
+
+from .cache import (
+    activate_persistent_cache,
+    persistent_cache_dir,
+    persistent_cache_entries,
+)
+from .monitor import CompileMonitor, get_compile_monitor
+from .warmup import batch_spec_of, spec_like, warm_step
+
+__all__ = [
+    "activate_persistent_cache",
+    "persistent_cache_dir",
+    "persistent_cache_entries",
+    "CompileMonitor",
+    "get_compile_monitor",
+    "batch_spec_of",
+    "spec_like",
+    "warm_step",
+]

@@ -1,0 +1,126 @@
+"""Persistent XLA compilation cache activation.
+
+JAX ships a content-addressed on-disk compilation cache (keyed on the
+optimized HLO + compile options + backend version); pointing every
+process of a run — and every *variant* of a bench sweep — at one
+directory turns the second-and-later compiles of an identical program
+into a fast deserialize. This module is the single place that translates
+:class:`~accelerate_tpu.utils.dataclasses.CompilePlugin` knobs into the
+``jax.config`` flags that implement it.
+
+Activation is idempotent and happens at ``AcceleratorState`` init (the
+same once-per-process seat that builds the mesh); scripts that never
+construct an Accelerator can call :func:`activate_persistent_cache`
+directly.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+import jax
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+_lock = threading.Lock()
+_active_dir: Optional[str] = None
+
+
+def _set_flag(name: str, value: Any) -> bool:
+    """jax.config.update that tolerates flags missing on older/newer jax
+    (the knob is then advisory): returns True when the flag stuck."""
+    try:
+        jax.config.update(name, value)
+        return True
+    except (AttributeError, KeyError, ValueError) as exc:
+        logger.warning("compile-cache knob %s=%r not applied: %s", name, value, exc)
+        return False
+
+
+def activate_persistent_cache(plugin: Any = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``plugin.cache_dir``.
+
+    No-op (returns None) when the plugin carries no cache dir — the env
+    fallback ``ACCELERATE_TPU_COMPILE_CACHE`` is applied by
+    ``CompilePlugin.__post_init__``, so exporting that variable is enough
+    to turn the cache on for an unmodified script. Re-activation with the
+    same directory is free; switching directories mid-process resets
+    JAX's in-memory handle so the new location takes effect.
+
+    Returns the resolved absolute cache directory (created if missing).
+    """
+    global _active_dir
+    if plugin is None or not getattr(plugin, "cache_dir", None):
+        return None
+    path = os.path.abspath(os.path.expanduser(str(plugin.cache_dir)))
+    with _lock:
+        os.makedirs(path, exist_ok=True)
+        # The previously active dir may have been configured OUTSIDE this
+        # module (e.g. a conftest calling jax.config directly) — JAX's
+        # lazily-initialized in-memory cache handle stays bound to it, so
+        # detect the switch from the config value, not just our own state.
+        prev = _active_dir
+        if prev is None:
+            try:
+                prev = jax.config.jax_compilation_cache_dir
+            except AttributeError:
+                prev = None
+        switched = bool(prev) and prev != path
+        _set_flag("jax_enable_compilation_cache", True)
+        _set_flag("jax_compilation_cache_dir", path)
+        # Persistence floors: JAX's defaults (1s compile floor) are tuned
+        # for giant TPU programs; a bench sweep of small programs wants
+        # every compile persisted. None leaves JAX's default untouched.
+        if getattr(plugin, "cache_min_compile_time_secs", None) is not None:
+            _set_flag(
+                "jax_persistent_cache_min_compile_time_secs",
+                float(plugin.cache_min_compile_time_secs),
+            )
+        if getattr(plugin, "cache_min_entry_size_bytes", None) is not None:
+            _set_flag(
+                "jax_persistent_cache_min_entry_size_bytes",
+                int(plugin.cache_min_entry_size_bytes),
+            )
+        # Cache-key knobs: fold the per-backend XLA autotune/kernel caches
+        # into the same dir, and (diagnostics) log why a lookup missed.
+        if getattr(plugin, "cache_enable_xla_caches", None) is not None:
+            _set_flag(
+                "jax_persistent_cache_enable_xla_caches",
+                str(plugin.cache_enable_xla_caches),
+            )
+        if getattr(plugin, "explain_cache_misses", None):
+            _set_flag("jax_explain_cache_misses", True)
+        if switched:
+            try:
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as cc,
+                )
+
+                cc.reset_cache()
+            except Exception as exc:  # pragma: no cover - version drift
+                logger.warning("compilation cache reset failed: %s", exc)
+        if _active_dir != path:
+            logger.info("persistent XLA compilation cache: %s", path)
+        _active_dir = path
+    return path
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The directory activated this process (None when inactive)."""
+    return _active_dir
+
+
+def persistent_cache_entries(path: Optional[str] = None) -> int:
+    """Count cache entries on disk — a cheap proxy for 'did anything
+    persist' in smoke tests and bench records."""
+    path = path or _active_dir
+    if not path or not os.path.isdir(path):
+        return 0
+    n = 0
+    for _root, _dirs, files in os.walk(path):
+        n += len(files)
+    return n

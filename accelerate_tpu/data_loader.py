@@ -329,6 +329,43 @@ class DataLoaderShard(DataLoaderStateMixin):
     def total_batch_size(self) -> int:
         return self.global_batch_size
 
+    def batch_spec(self) -> Any:
+        """Abstract spec of one global device batch: a pytree of
+        ``jax.ShapeDtypeStruct`` with the shardings :meth:`__iter__` would
+        commit — the AOT-warmup contract (``accelerator.warmup``). Every
+        batch is padded to one fixed shape, so the first batch's spec is
+        THE spec.
+
+        Collates one host batch from a fresh iterator to read the shapes
+        (no device transfer, no training-iterator state touched)."""
+        source = self._factory()
+        try:
+            host_batch, _valid = next(iter(source))
+        except StopIteration:
+            raise ValueError("empty dataloader: no batch to derive a spec from")
+        finally:
+            close = getattr(source, "close", None)
+            if close is not None:
+                close()
+        host_batch = _to_numpy(host_batch)
+        num_processes = jax.process_count()
+        data_degree = _sharding_data_degree(self.sharding)
+
+        def _spec(x):
+            # mirror _device_put's placement decisions exactly
+            x = np.asarray(x)
+            if x.ndim == 0 or (x.shape[0] * num_processes) % data_degree != 0:
+                replicated = jax.sharding.NamedSharding(
+                    self.sharding.mesh, jax.sharding.PartitionSpec()
+                )
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=replicated)
+            global_shape = (x.shape[0] * num_processes,) + x.shape[1:]
+            return jax.ShapeDtypeStruct(global_shape, x.dtype, sharding=self.sharding)
+
+        return recursively_apply(
+            _spec, host_batch, test_type=lambda x: isinstance(x, np.ndarray)
+        )
+
     def __len__(self) -> int:
         if self._num_batches is None:
             raise TypeError("this dataloader has no length")

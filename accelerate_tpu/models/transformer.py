@@ -110,6 +110,24 @@ def rope(
     scaling: Optional[dict] = None,
 ) -> jax.Array:
     """Rotary position embedding, x: (B, S, H, D), positions: (B, S)."""
+    from ..parallel.sharding import live_mesh
+
+    mesh = live_mesh()
+    if mesh is not None:
+        # The rotation pairs element i with element i + D/2 across the last
+        # dim. When the qkv projection's output sharding propagates a
+        # head_dim split into here (heuristic FSDP merging heads*head_dim),
+        # XLA's SPMD partitioner produces numerically wrong attention
+        # downstream of the split/concat (observed ~1e-2 logit divergence
+        # vs the same weights replicated; q/k themselves and the attention
+        # core are each exact in isolation). Pin head_dim unsplit through
+        # the rotation; every other dim stays free for the partitioner.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec(
+            *([PartitionSpec.UNCONSTRAINED] * (x.ndim - 1)), None
+        )
+        x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
     d = x.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
     freqs = _scale_rope_freqs(freqs, scaling)

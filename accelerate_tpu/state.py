@@ -281,6 +281,7 @@ class AcceleratorState:
         parallelism_plugin: Optional[ParallelismPlugin] = None,
         gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None,
         dataloader_config: Optional[DataLoaderConfiguration] = None,
+        compile_plugin=None,
         **kwargs,
     ):
         self.__dict__ = self._shared_state
@@ -305,6 +306,17 @@ class AcceleratorState:
         )
         self.parallelism_plugin = parallelism_plugin or ParallelismPlugin.pure_dp()
         self.dataloader_config = dataloader_config or DataLoaderConfiguration()
+        # Persistent XLA compilation cache: activated here — the same
+        # once-per-process seat that builds the mesh — so every jit in the
+        # process (user code included, not just the unified step) reuses
+        # compiles across restarts. No-op without a cache_dir (env:
+        # ACCELERATE_TPU_COMPILE_CACHE).
+        self.compile_plugin = compile_plugin
+        self.compile_cache_dir = None
+        if compile_plugin is not None:
+            from .compilation import activate_persistent_cache
+
+            self.compile_cache_dir = activate_persistent_cache(compile_plugin)
         self.mesh = build_mesh(self.parallelism_plugin)
         self.data_axis_names = data_axes(self.mesh)
         self.data_parallel_size = mesh_axis_size(self.mesh, *self.data_axis_names)

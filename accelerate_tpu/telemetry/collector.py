@@ -183,10 +183,16 @@ class StepTelemetry:
         metrics: Any = None,
         retraced: bool = False,
         label: str = "step",
+        compile_stats: Optional[dict] = None,
     ) -> Optional[dict]:
         """Complete one step: block on ``result`` (the async boundary),
         build the record, emit to sinks, beat the heartbeat. Returns the
-        record (None while disabled)."""
+        record (None while disabled).
+
+        ``compile_stats`` (from ``CompileMonitor.delta``) attributes any
+        compile cost this step paid: XLA compile seconds and
+        persistent-cache hit/miss counts land on the step record, so a
+        first-step (or retrace) latency spike is explained in place."""
         if not self.enabled:
             return None
         total_s, dispatch_s = self._timer.stop(result)
@@ -202,6 +208,20 @@ class StepTelemetry:
             "recompiles": sum(d.retraces for d in self._detectors.values()),
         }
         self._dl_wait = 0.0
+        if compile_stats:
+            record["compile_time_s"] = float(
+                compile_stats.get("compile_time_s", 0.0)
+            )
+            record["persistent_cache_hits"] = int(
+                compile_stats.get("persistent_cache_hits", 0)
+            )
+            record["persistent_cache_misses"] = int(
+                compile_stats.get("persistent_cache_misses", 0)
+            )
+            if compile_stats.get("compile_time_saved_s"):
+                record["compile_time_saved_s"] = float(
+                    compile_stats["compile_time_saved_s"]
+                )
 
         tokens = None
         if batch is not None:
@@ -248,6 +268,35 @@ class StepTelemetry:
         self._emit(record)
         if self.heartbeat is not None:
             self.heartbeat.beat(step)
+        return record
+
+    def record_compile(
+        self,
+        *,
+        label: str = "step",
+        source: str = "warmup",
+        compile_time_s: Optional[float] = None,
+        persistent_cache_hits: int = 0,
+        persistent_cache_misses: int = 0,
+        **extra: Any,
+    ) -> Optional[dict]:
+        """Emit a ``kind="compile"`` record — one AOT warmup (or any
+        out-of-step compile worth attributing). Flows through the same
+        sinks as step records; None while disabled."""
+        if not self.enabled:
+            return None
+        record: dict[str, Any] = {
+            "kind": "compile",
+            "label": label,
+            "source": source,
+            "time_unix": time.time(),
+            "compile_time_s": compile_time_s,
+            "persistent_cache_hits": int(persistent_cache_hits),
+            "persistent_cache_misses": int(persistent_cache_misses),
+        }
+        for key, value in extra.items():
+            record.setdefault(key, value)
+        self._emit(record)
         return record
 
     # ------------------------------------------------------------------ #

@@ -32,6 +32,22 @@ JSONL record schema (one object per line; ``kind`` discriminates):
     recompiles         int    cumulative retraces beyond first compiles
     loss/grad_norm/... float  0-d numeric step metrics (include_step_metrics)
 
+Steps that paid compile cost additionally carry (from ``CompileMonitor``):
+
+    compile_time_s            float  XLA backend-compile seconds this step
+    persistent_cache_hits     int    persistent-cache executables reused
+    persistent_cache_misses   int    lookups that had to compile
+    compile_time_saved_s      float  compile seconds a cache hit avoided
+
+``kind="compile"`` (one per AOT warmup / attributed out-of-step compile)::
+
+    label                    str    step fn the compile belongs to
+    source                   str    "warmup" (or caller-provided)
+    compile_time_s           float  wall time of lower+compile
+    backend_compile_s        float  XLA backend compile seconds within it
+    persistent_cache_hits    int    cache hits during the compile
+    persistent_cache_misses  int    cache misses during the compile
+
 Fields marked ``?`` are null when not derivable; memory fields are absent
 on steps skipped by ``memory_interval``.
 """

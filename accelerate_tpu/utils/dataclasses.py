@@ -342,13 +342,30 @@ class CompilePlugin(KwargsHandler):
     tunes how."""
 
     donate_state: bool = True  # donate params/opt-state buffers to the step
+    # kwargs of the user loss_fn to treat as compile-time constants in the
+    # unified step (jax.jit static_argnames)
     static_argnames: tuple[str, ...] = ()
+    # XLA backend options, threaded into .lower().compile(...) by warmup
     compiler_options: Optional[dict[str, Any]] = None
     cache_dir: Optional[str] = None  # persistent compilation cache
+    # Persistence floors: JAX defaults persist only compiles >1s / >4KiB —
+    # tuned for giant programs. 0.0 / -1 persist everything (what a bench
+    # sweep of small programs wants). None leaves JAX's default untouched.
+    cache_min_compile_time_secs: Optional[float] = 0.0
+    cache_min_entry_size_bytes: Optional[int] = -1
+    # cache-key scope: "all" folds the per-backend XLA autotune/kernel
+    # caches into the same dir; "none" keeps only the executable cache
+    cache_enable_xla_caches: Optional[str] = None
+    # diagnostics: log WHY a lookup missed (first differing key field)
+    explain_cache_misses: bool = False
 
     def __post_init__(self):
         if self.cache_dir is None:
             self.cache_dir = os.environ.get(ENV_PREFIX + "COMPILE_CACHE")
+        if isinstance(self.static_argnames, str):
+            self.static_argnames = (self.static_argnames,)
+        else:
+            self.static_argnames = tuple(self.static_argnames)
 
 
 @dataclass

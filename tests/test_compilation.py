@@ -1,0 +1,347 @@
+"""Compilation subsystem tests: persistent-cache activation and round-trip
+hits, AOT warmup through the real ``unified_step`` path (zero retraces on
+the first real batch), compile-cost attribution, and one wired-consumer
+test per ``CompilePlugin`` knob (``cache_dir``, ``static_argnames``,
+``compiler_options``). All CPU-runnable on the virtual 8-device backend.
+
+The persistent-cache tests mutate process-wide jax config (the conftest
+installs its own cache for the whole suite) — every mutation goes through
+``restore_cache_config`` so later tests see the conftest settings again.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, DataLoader, TelemetryConfig
+from accelerate_tpu.compilation import (
+    activate_persistent_cache,
+    batch_spec_of,
+    get_compile_monitor,
+    persistent_cache_dir,
+    persistent_cache_entries,
+    spec_like,
+)
+from accelerate_tpu.compilation import cache as cache_mod
+from accelerate_tpu.utils.dataclasses import CompilePlugin
+
+
+def _fresh_accelerator(**kwargs) -> Accelerator:
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def loss_fn(params, batch):
+    pred = batch["x"] * params["w"] + params["b"]
+    return jnp.mean(pred**2)
+
+
+_CACHE_FLAGS = (
+    "jax_enable_compilation_cache",
+    "jax_compilation_cache_dir",
+    "jax_persistent_cache_min_compile_time_secs",
+    "jax_persistent_cache_min_entry_size_bytes",
+    "jax_persistent_cache_enable_xla_caches",
+    "jax_explain_cache_misses",
+)
+
+
+@pytest.fixture
+def restore_cache_config():
+    """Snapshot the jax cache config (set process-wide by conftest) and
+    restore it after the test, so per-test cache dirs can't leak into the
+    rest of the suite."""
+    saved = {}
+    for name in _CACHE_FLAGS:
+        try:
+            saved[name] = getattr(jax.config, name)
+        except AttributeError:
+            pass
+    saved_active = cache_mod._active_dir
+    yield
+    for name, value in saved.items():
+        try:
+            jax.config.update(name, value)
+        except Exception:
+            pass
+    cache_mod._active_dir = saved_active
+    try:
+        from jax.experimental.compilation_cache import compilation_cache as cc
+
+        cc.reset_cache()
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------- #
+# CompilePlugin.cache_dir -> persistent cache activation (wired consumer)
+# ---------------------------------------------------------------------- #
+def test_cache_dir_activates_and_writes_entries(tmp_path, restore_cache_config):
+    target = tmp_path / "xla_cache"
+    plugin = CompilePlugin(
+        cache_dir=str(target),
+        cache_min_compile_time_secs=0.0,
+        cache_min_entry_size_bytes=-1,
+        cache_enable_xla_caches="all",
+    )
+    resolved = activate_persistent_cache(plugin)
+    assert resolved == os.path.abspath(str(target))
+    assert persistent_cache_dir() == resolved
+    assert os.path.isdir(resolved)
+    # activation is idempotent: same dir again is a no-op, not a reset
+    assert activate_persistent_cache(plugin) == resolved
+
+    jax.jit(lambda x: x * 2.0 + 1.0)(jnp.arange(8.0)).block_until_ready()
+    assert persistent_cache_entries(resolved) > 0
+
+
+def test_no_cache_dir_is_a_noop(restore_cache_config, monkeypatch):
+    monkeypatch.delenv("ACCELERATE_TPU_COMPILE_CACHE", raising=False)
+    assert activate_persistent_cache(CompilePlugin()) is None
+
+
+def test_env_var_seeds_plugin_cache_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_COMPILE_CACHE", str(tmp_path / "env"))
+    assert CompilePlugin().cache_dir == str(tmp_path / "env")
+    # an explicit cache_dir wins over the env
+    assert CompilePlugin(cache_dir="/explicit").cache_dir == "/explicit"
+
+
+def test_state_activates_cache_from_plugin(tmp_path, restore_cache_config):
+    acc = _fresh_accelerator(
+        compile_plugin=CompilePlugin(
+            cache_dir=str(tmp_path / "state_cache"),
+            cache_min_compile_time_secs=0.0,
+            cache_min_entry_size_bytes=-1,
+        )
+    )
+    assert acc.state.compile_cache_dir == os.path.abspath(
+        str(tmp_path / "state_cache")
+    )
+    assert persistent_cache_dir() == acc.state.compile_cache_dir
+
+
+# ---------------------------------------------------------------------- #
+# persistent-cache round trip: a second jit of the same program is a HIT
+# ---------------------------------------------------------------------- #
+def test_persistent_cache_round_trip_records_hit(tmp_path, restore_cache_config):
+    mon = get_compile_monitor()
+    activate_persistent_cache(
+        CompilePlugin(
+            cache_dir=str(tmp_path),
+            cache_min_compile_time_secs=0.0,
+            cache_min_entry_size_bytes=-1,
+            cache_enable_xla_caches="all",
+        )
+    )
+
+    def make():  # fresh jit wrapper each time: same program, no jit cache
+        return jax.jit(lambda x: jnp.sin(x) * 3.0 + jnp.cos(x))
+
+    before = mon.snapshot()
+    make()(jnp.arange(16.0)).block_until_ready()
+    first = mon.delta(before)
+    assert first.get("persistent_cache_misses", 0) >= 1
+
+    before = mon.snapshot()
+    make()(jnp.arange(16.0)).block_until_ready()
+    second = mon.delta(before)
+    assert second.get("persistent_cache_hits", 0) >= 1
+    assert second.get("persistent_cache_misses", 0) == 0
+    # a hit deserializes instead of compiling (a few ms of auxiliary
+    # backend work can still accrue — don't assert exactly zero)
+    assert second.get("cache_retrieval_s", 0.0) > 0.0
+
+
+def test_compile_monitor_attributes_by_label():
+    mon = get_compile_monitor()
+    before = mon.snapshot()
+    with mon.label("probe-label"):
+        jax.jit(lambda x: x @ x.T)(
+            jnp.arange(12.0).reshape(3, 4)
+        ).block_until_ready()
+    delta = mon.delta(before)
+    assert delta.get("trace_time_s", 0.0) > 0.0
+    stats = mon.stats_for("probe-label")
+    assert stats.get("trace_time_s", 0.0) > 0.0
+
+
+# ---------------------------------------------------------------------- #
+# CompilePlugin.static_argnames -> unified_step jit (wired consumer)
+# ---------------------------------------------------------------------- #
+def _loss_with_flag(params, batch, use_l2=False):
+    pred = batch["x"] * params["w"] + params["b"]
+    if use_l2:  # python-level branch: only a STATIC kwarg can reach here
+        return jnp.mean(pred**2)
+    return jnp.mean(jnp.abs(pred))
+
+
+def test_static_argnames_wired_into_unified_step():
+    acc = _fresh_accelerator(
+        compile_plugin=CompilePlugin(static_argnames=("use_l2",))
+    )
+    params = {"w": jnp.asarray(2.0), "b": jnp.asarray(0.1)}
+    params, opt = acc.prepare(params, optax.sgd(0.0))
+    step = acc.unified_step(_loss_with_flag, opt)
+    carry = acc.init_carry(params, opt)
+    batch = {"x": jnp.asarray(np.full((8,), 3.0, np.float32))}
+    carry, m_l1 = step(carry, batch, use_l2=False)
+    carry, m_l2 = step(carry, batch, use_l2=True)
+    # the static flag selected two different programs with different math
+    assert abs(float(m_l1["loss"]) - float(m_l2["loss"])) > 1.0
+
+
+def test_kwarg_is_traced_without_static_argnames():
+    acc = _fresh_accelerator()  # default plugin: no static names
+    params = {"w": jnp.asarray(2.0), "b": jnp.asarray(0.1)}
+    params, opt = acc.prepare(params, optax.sgd(0.0))
+    step = acc.unified_step(_loss_with_flag, opt)
+    carry = acc.init_carry(params, opt)
+    batch = {"x": jnp.asarray(np.full((8,), 3.0, np.float32))}
+    with pytest.raises(jax.errors.TracerBoolConversionError):
+        step(carry, batch, use_l2=True)
+
+
+def test_plugin_normalizes_string_static_argnames():
+    assert CompilePlugin(static_argnames="flag").static_argnames == ("flag",)
+
+
+# ---------------------------------------------------------------------- #
+# CompilePlugin.compiler_options -> .lower().compile() (wired consumer)
+# ---------------------------------------------------------------------- #
+def test_compiler_options_reach_lowered_compile(monkeypatch):
+    import jax.stages
+
+    seen = {}
+    orig = jax.stages.Lowered.compile
+
+    def spy(self, compiler_options=None, **kw):
+        seen["compiler_options"] = compiler_options
+        return orig(self, compiler_options=compiler_options, **kw)
+
+    monkeypatch.setattr(jax.stages.Lowered, "compile", spy)
+
+    opts = {"xla_embed_ir_in_executable": True}
+    acc = _fresh_accelerator(
+        compile_plugin=CompilePlugin(compiler_options=opts)
+    )
+    params = {"w": jnp.asarray(1.0), "b": jnp.asarray(0.5)}
+    params, opt = acc.prepare(params, optax.sgd(0.1))
+    step = acc.unified_step(loss_fn, opt)
+    carry = acc.init_carry(params, opt)
+    batch = {"x": jnp.asarray(np.ones((8,), np.float32))}
+    acc.warmup(step, carry, batch)
+    assert seen["compiler_options"] == opts
+    # the AOT executable compiled with those options serves the real call
+    carry, metrics = step(carry, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+# ---------------------------------------------------------------------- #
+# AOT warmup: specs from the prepared dataloader, zero retraces, compile
+# records through the telemetry sinks (the acceptance demo)
+# ---------------------------------------------------------------------- #
+def test_dataloader_batch_spec_matches_real_batch():
+    acc = _fresh_accelerator()
+    ds = [{"x": np.full((3,), float(i), np.float32)} for i in range(16)]
+    prepared = acc.prepare(DataLoader(ds, batch_size=8, shuffle=False))
+    spec = prepared.batch_spec()
+    batch = next(iter(prepared))
+    got = jax.tree.map(lambda s: (s.shape, jnp.dtype(s.dtype)), spec)
+    want = jax.tree.map(lambda a: (a.shape, jnp.dtype(a.dtype)), batch)
+    assert got == want
+
+
+def test_spec_like_keeps_committed_sharding_only():
+    committed = jax.device_put(jnp.arange(4.0), jax.devices()[0])
+    uncommitted = jnp.arange(4.0)  # jit is free to place it; spec must be too
+    specs = spec_like({"c": committed, "u": uncommitted, "n": np.zeros(2)})
+    assert specs["c"].sharding == committed.sharding
+    assert specs["u"].sharding is None
+    assert specs["n"].shape == (2,)
+    # batch_spec_of on a plain pytree falls through to spec_like
+    assert batch_spec_of({"u": uncommitted})["u"].shape == (4,)
+
+
+def test_warmup_then_first_step_never_retraces(tmp_path):
+    jsonl = tmp_path / "telemetry.jsonl"
+    acc = _fresh_accelerator(
+        telemetry=TelemetryConfig(jsonl_path=str(jsonl))
+    )
+    ds = [{"x": np.full((2,), float(i), np.float32)} for i in range(32)]
+    loader = DataLoader(ds, batch_size=8, shuffle=False)
+    params = {"w": jnp.asarray(1.0), "b": jnp.asarray(0.5)}
+    params, opt, prepared = acc.prepare(params, optax.sgd(0.1), loader)
+    step = acc.unified_step(loss_fn, opt)
+    carry = acc.init_carry(params, opt)
+
+    record = acc.warmup(step, carry, prepared)
+    assert record["label"] == step.label
+    assert record["compile_time_s"] > 0
+    assert record["persistent_cache_hits"] >= 0
+    assert record["persistent_cache_misses"] >= 0
+
+    detector = acc.telemetry.detector(step.label)
+    signatures_after_warmup = len(detector._seen)
+    steps = 0
+    for batch in prepared:
+        carry, metrics = step(carry, batch)
+        steps += 1
+    assert steps >= 3
+    assert np.isfinite(float(metrics["loss"]))
+    # the warmed signature covered every real call: no retrace, and the
+    # first real batch added NO new signature (true AOT dispatch)
+    assert detector.retraces == 0
+    assert len(detector._seen) == signatures_after_warmup
+
+    lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    compile_recs = [l for l in lines if l["kind"] == "compile"]
+    assert len(compile_recs) == 1
+    assert compile_recs[0]["source"] == "warmup"
+    assert compile_recs[0]["label"] == step.label
+    assert compile_recs[0]["compile_time_s"] > 0
+    assert "persistent_cache_hits" in compile_recs[0]
+    assert "persistent_cache_misses" in compile_recs[0]
+    step_recs = [l for l in lines if l["kind"] == "step"]
+    assert len(step_recs) == steps
+    # no step paid compile cost: retraced stays False and the compile
+    # fields never appear on a step record
+    for rec in step_recs:
+        assert rec["retraced"] is False
+        assert "compile_time_s" not in rec
+
+
+def test_warmup_matches_unwarmed_numerics():
+    ds = [{"x": np.full((2,), float(i), np.float32)} for i in range(32)]
+
+    def run(warm: bool):
+        acc = _fresh_accelerator()
+        loader = DataLoader(ds, batch_size=8, shuffle=False)
+        # fresh param leaves per run: the donated carry consumes them
+        params = {"w": jnp.asarray(1.0), "b": jnp.asarray(0.5)}
+        p, opt, prepared = acc.prepare(params, optax.sgd(0.1), loader)
+        step = acc.unified_step(loss_fn, opt)
+        carry = acc.init_carry(p, opt)
+        if warm:
+            acc.warmup(step, carry, prepared)
+        losses = []
+        for batch in prepared:
+            carry, metrics = step(carry, batch)
+            losses.append(float(metrics["loss"]))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+def test_warmup_rejects_bare_callables():
+    acc = _fresh_accelerator()
+    with pytest.raises(TypeError, match="unified_step"):
+        acc.warmup(lambda c, b: (c, {}), {}, {})
