@@ -27,9 +27,10 @@ FAST_FILES = \
   tests/test_optimizer_scheduler.py tests/test_state.py \
   tests/test_data_loader.py tests/test_checkpointing.py \
   tests/test_ring_attention.py tests/test_seq2seq.py \
-  tests/test_telemetry.py tests/test_compilation.py
+  tests/test_telemetry.py tests/test_compilation.py \
+  tests/test_checkpoint_async.py
 
-.PHONY: test test-fast test-cold compile-cache-smoke
+.PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -48,3 +49,13 @@ compile-cache-smoke:
 	$(PYTEST) -q \
 	  tests/test_compilation.py::test_warmup_then_first_step_never_retraces \
 	  tests/test_compilation.py::test_persistent_cache_round_trip_records_hit
+
+# end-to-end crash-safety check of the async checkpoint subsystem: a short
+# train loop saving async every 2 steps is SIGKILLed between a save's
+# device->host snapshot and its commit rename; the run directory must hold
+# only COMMITTED checkpoints plus the orphaned .tmp, and restore must land
+# on the last committed one. The blocked-time acceptance test rides along.
+ckpt-smoke:
+	$(PYTEST) -q \
+	  tests/test_checkpoint_async.py::test_kill_between_snapshot_and_commit_falls_back \
+	  tests/test_checkpoint_async.py::test_async_blocked_time_excludes_serialization_and_io

@@ -19,6 +19,7 @@ from .big_modeling import (
     materialize_offloaded,
     streamed_apply,
 )
+from .checkpoint_async import AsyncCheckpointer, save_accelerator_state_async
 from .data_loader import DataLoader, prepare_data_loader, skip_first_batches
 from .fault_tolerance import CheckpointManager
 from .launchers import debug_launcher, notebook_launcher
@@ -64,6 +65,8 @@ __all__ = [
     "notebook_launcher",
     "LocalSGD",
     "CheckpointManager",
+    "AsyncCheckpointer",
+    "save_accelerator_state_async",
     "find_executable_batch_size",
     "Accelerator",
     "AcceleratedOptimizer",

@@ -1084,12 +1084,53 @@ class Accelerator:
             )
         self._custom_objects.extend(objects)
 
-    def save_state(self, output_dir: Optional[str] = None, carry: Any = None, **kwargs):
+    def save_state(
+        self,
+        output_dir: Optional[str] = None,
+        carry: Any = None,
+        block: bool = True,
+        **kwargs,
+    ):
+        """Checkpoint the full training state (reference :2858).
+
+        ``block=False`` routes through the async subsystem
+        (:mod:`accelerate_tpu.checkpoint_async`): the call returns after
+        the device->host snapshot and the background writer serializes,
+        writes and atomically commits while training continues. The
+        returned dir is the final name the save will commit to — call
+        :meth:`wait_for_checkpoint` to block on durability. Sync saves
+        drain any in-flight async save first, so checkpoints always
+        commit in save order."""
+        if not block:
+            from .checkpoint_async import save_accelerator_state_async
+
+            return save_accelerator_state_async(
+                self, self._async_checkpointer, output_dir, carry=carry, **kwargs
+            )
+        self.wait_for_checkpoint()
         from .checkpointing import save_accelerator_state
 
         return save_accelerator_state(self, output_dir, carry=carry, **kwargs)
 
+    @property
+    def _async_checkpointer(self):
+        """Lazy per-accelerator background checkpoint writer."""
+        ckpt = getattr(self, "_async_ckpt", None)
+        if ckpt is None:
+            from .checkpoint_async import AsyncCheckpointer
+
+            ckpt = self._async_ckpt = AsyncCheckpointer(telemetry=self.telemetry)
+        return ckpt
+
+    def wait_for_checkpoint(self):
+        """Drain in-flight ``save_state(block=False)`` saves (no-op when
+        none exist); background write failures re-raise here."""
+        ckpt = getattr(self, "_async_ckpt", None)
+        if ckpt is not None:
+            ckpt.wait()
+
     def load_state(self, input_dir: Optional[str] = None, carry: Any = None, **kwargs):
+        self.wait_for_checkpoint()  # never restore past an in-flight save
         from .checkpointing import load_accelerator_state
 
         return load_accelerator_state(self, input_dir, carry=carry, **kwargs)
@@ -1163,6 +1204,7 @@ class Accelerator:
         raise ValueError(f"tracker {name} not initialized")
 
     def end_training(self):
+        self.wait_for_checkpoint()  # a dropped in-flight save loses work
         for tracker in self.trackers:
             tracker.finish()
         self.telemetry.close()

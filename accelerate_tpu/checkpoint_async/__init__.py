@@ -1,0 +1,42 @@
+"""Async distributed checkpointing: zero-stall saves with per-host
+sharded writes and an atomic commit protocol.
+
+The synchronous save path blocks every host for device->host transfer +
+serialization + disk IO; this subsystem shrinks the train-loop cost of a
+save to ONE batched ``jax.device_get`` of this process's own shards and
+hides everything else behind the next training steps (the same move the
+compilation subsystem made for compile cost: pay it off the hot path).
+CheckFreq (FAST '21) and Orbax's async checkpointing proved the shape:
+snapshot fast, persist in the background, commit atomically.
+
+Layers:
+
+* :mod:`.commit` — the atomic commit protocol (``<dir>.tmp`` work dirs,
+  per-host ``done_*`` markers, a filesystem barrier, one rename +
+  ``COMMITTED``). Shared by the sync path too: no save, sync or async,
+  can leave a torn checkpoint.
+* :mod:`.writer` — :class:`AsyncCheckpointer` (the bounded background
+  writer thread) and :func:`save_accelerator_state_async` (the
+  snapshot-then-enqueue counterpart of ``save_accelerator_state``).
+
+Entry points: ``CheckpointManager(..., async_saves=True)`` for managed
+loops, ``accelerator.save_state(..., block=False)`` for direct use.
+"""
+
+from .commit import (
+    COMMITTED_MARKER,
+    TMP_SUFFIX,
+    is_committed,
+    work_dir_for,
+)
+from .writer import AsyncCheckpointer, CheckpointJob, save_accelerator_state_async
+
+__all__ = [
+    "AsyncCheckpointer",
+    "CheckpointJob",
+    "save_accelerator_state_async",
+    "COMMITTED_MARKER",
+    "TMP_SUFFIX",
+    "is_committed",
+    "work_dir_for",
+]

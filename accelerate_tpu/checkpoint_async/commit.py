@@ -1,0 +1,156 @@
+"""Atomic commit protocol for checkpoint directories.
+
+A checkpoint is either COMMITTED or invisible. Writers (sync or async,
+any number of hosts on a shared filesystem — the same assumption
+:mod:`~accelerate_tpu.dist_checkpoint` already makes) follow:
+
+1. every host writes its files into ``<final>.tmp/`` — the work dir;
+2. each host fsyncs its files and drops a ``done_{proc:05d}`` marker;
+3. hosts barrier on the markers (a filesystem poll, NOT a jax collective
+   — commit may run on a background thread where collectives are unsafe);
+4. host 0 writes the ``COMMITTED`` marker inside the work dir, fsyncs,
+   and executes ONE ``os.rename(work, final)``.
+
+Readers (``_list_checkpoints`` / ``restore_or_init`` / ``load_state``)
+only match ``checkpoint_<n>`` names, so a ``.tmp`` work dir — the only
+on-disk state a crash at any point before step 4's rename can leave —
+is never listed, never restored from, and never counted or deleted by
+rotation. The rename is atomic on POSIX: a reader sees either no
+directory or a complete one carrying ``COMMITTED``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+TMP_SUFFIX = ".tmp"
+COMMITTED_MARKER = "COMMITTED"
+DONE_MARKER_PATTERN = "done_{:05d}"
+
+
+def work_dir_for(final_dir: str) -> str:
+    """The uncommitted work dir a save targets before the commit rename."""
+    return os.path.normpath(final_dir) + TMP_SUFFIX
+
+
+def is_work_dir(path: str) -> bool:
+    return os.path.normpath(path).endswith(TMP_SUFFIX)
+
+
+def is_committed(path: str) -> bool:
+    """True when ``path`` carries the COMMITTED marker. Checkpoints written
+    before the commit protocol existed lack the marker but were also never
+    renamed into place, so completeness checks must pair this with the
+    ``.tmp``-name exclusion rather than require the marker outright."""
+    return os.path.isfile(os.path.join(path, COMMITTED_MARKER))
+
+
+def _fsync_path(path: str) -> None:
+    """fsync a file (or directory entry) by fd; best-effort on filesystems
+    that reject directory fsync (e.g. some network mounts)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_marker(directory: str, name: str) -> str:
+    """Durably create ``directory/name`` (empty marker file): write, fsync
+    the file, fsync the directory so the entry itself survives a crash."""
+    path = os.path.join(directory, name)
+    with open(path, "w") as f:
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_path(directory)
+    return path
+
+
+def mark_done(work_dir: str, process_index: int) -> str:
+    """This host's shard files are written + fsynced; publish the fact."""
+    return write_marker(work_dir, DONE_MARKER_PATTERN.format(process_index))
+
+
+def wait_for_done_markers(
+    work_dir: str,
+    world: int,
+    timeout_s: float = 600.0,
+    poll_s: float = 0.05,
+) -> None:
+    """Block until every host's done marker exists (trivial when world==1)."""
+    deadline = time.monotonic() + timeout_s
+    missing = list(range(world))
+    while missing:
+        missing = [
+            p
+            for p in missing
+            if not os.path.isfile(
+                os.path.join(work_dir, DONE_MARKER_PATTERN.format(p))
+            )
+        ]
+        if not missing:
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"checkpoint commit barrier timed out after {timeout_s}s: "
+                f"missing done markers from processes {missing} in {work_dir}"
+            )
+        time.sleep(poll_s)
+
+
+def commit(
+    work_dir: str,
+    final_dir: str,
+    process_index: int = 0,
+    world: int = 1,
+    timeout_s: float = 600.0,
+) -> str:
+    """Run steps 2-4 of the protocol for this host; returns ``final_dir``.
+
+    Process 0 performs the rename; other processes return once the final
+    directory is visible (so a caller may read it back immediately)."""
+    mark_done(work_dir, process_index)
+    wait_for_done_markers(work_dir, world, timeout_s=timeout_s)
+    if process_index == 0:
+        write_marker(work_dir, COMMITTED_MARKER)
+        if os.path.isdir(final_dir):
+            # explicit-output_dir overwrite: swap the old dir aside first so
+            # the rename still lands atomically (the .old name matches no
+            # checkpoint pattern, so a crash here leaves it invisible)
+            backup = f"{final_dir}.old.{os.getpid()}"
+            os.rename(final_dir, backup)
+            os.rename(work_dir, final_dir)
+            shutil.rmtree(backup, ignore_errors=True)
+        else:
+            os.rename(work_dir, final_dir)
+        _fsync_path(os.path.dirname(os.path.normpath(final_dir)) or ".")
+        logger.info(f"committed checkpoint {final_dir}")
+    else:
+        deadline = time.monotonic() + timeout_s
+        while not os.path.isdir(final_dir):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"process {process_index}: {final_dir} did not appear "
+                    f"within {timeout_s}s of the commit barrier"
+                )
+            time.sleep(0.05)
+    return final_dir
+
+
+def discard_work_dir(work_dir: str) -> None:
+    """Remove an uncommitted work dir (stale tmp from a crashed run, or
+    cleanup after a failed background write). Never called on a committed
+    (renamed) directory."""
+    if is_work_dir(work_dir):
+        shutil.rmtree(work_dir, ignore_errors=True)

@@ -1,0 +1,284 @@
+"""The bounded background checkpoint writer.
+
+The train-loop-side cost of an async save is ONLY the device->host
+snapshot (one batched ``jax.device_get`` of this process's addressable
+shards — no rank-0 allgather, no serialization, no disk). The snapshot is
+handed to a single daemon writer thread that serializes, writes, fsyncs,
+and runs the atomic commit protocol, all while the next training steps
+execute on device.
+
+Backpressure: the job queue is bounded (``max_pending``, default 1). If
+saves arrive faster than disk drains them, ``submit`` blocks the train
+loop until a slot frees — checkpoints are never silently dropped and
+host RAM holds at most ``max_pending + 1`` snapshots. The blocked time
+(snapshot + any queue wait) and the hidden background time are reported
+separately through telemetry as ``kind="checkpoint"`` records.
+
+The writer thread performs NO jax calls — device access is complete by
+the time a job is enqueued — so it is safe next to collectives running
+on the main thread. Background failures are captured and re-raised on
+the next ``submit``/``wait`` (a checkpointing subsystem that fails
+silently is worse than a slow one).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..logging import get_logger
+from . import commit as commit_mod
+
+logger = get_logger(__name__)
+
+_STOP = object()
+
+
+@dataclasses.dataclass
+class CheckpointJob:
+    """Everything one async save needs after the snapshot: host-resident
+    array chunks, captured host state, and the commit coordinates."""
+
+    final_dir: str
+    work_dir: str
+    shard_snapshot: Any  # dist_checkpoint.ShardSnapshot | None
+    host_files: list  # [(filename, kind, payload)] from _capture_host_state
+    named_files: list  # [(filename, named_dict, safe)] raw-loop opt states
+    process_index: int
+    world: int
+    step: Optional[int]
+    blocked_s: float  # snapshot + queue-wait seconds (filled by the caller)
+    barrier_timeout_s: float = 600.0
+
+
+class AsyncCheckpointer:
+    """Owns the writer thread and the in-flight bookkeeping.
+
+    One instance serializes its saves: jobs run in submission order on a
+    single thread, so two async saves can never interleave writes or
+    commit out of order. ``wait()`` drains everything in flight (the
+    preemption contract: drain, then write the final checkpoint
+    synchronously); ``close()`` drains and stops the thread.
+    """
+
+    def __init__(
+        self,
+        telemetry: Any = None,
+        max_pending: int = 1,
+        barrier_timeout_s: float = 600.0,
+    ):
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        self.telemetry = telemetry
+        self.max_pending = max_pending
+        self.barrier_timeout_s = barrier_timeout_s
+        self._queue: queue.Queue = queue.Queue(maxsize=max_pending)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._pending = 0  # jobs submitted and not yet finished
+        self._idle = threading.Event()
+        self._idle.set()
+        self.saves_completed = 0
+
+    # ------------------------------------------------------------------ #
+    @property
+    def in_flight(self) -> bool:
+        """True while any submitted save has not finished writing."""
+        return not self._idle.is_set()
+
+    def _raise_pending_error(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(
+                "a background checkpoint write failed; the checkpoint was "
+                "NOT committed (its .tmp work dir was discarded)"
+            ) from err
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="ckpt-writer", daemon=True
+            )
+            self._thread.start()
+
+    def submit(self, job: CheckpointJob) -> float:
+        """Enqueue a job; blocks only when ``max_pending`` saves are already
+        queued (backpressure). The queue-wait seconds are folded into
+        ``job.blocked_s`` BEFORE the job is enqueued (the writer thread
+        reads the job afterwards, so it must not be mutated post-put) and
+        also returned."""
+        self._raise_pending_error()
+        self._ensure_thread()
+        wait_s = 0.0
+        if self._queue.full():
+            # single producer: once not-full, the put below cannot block
+            t0 = time.perf_counter()
+            while self._queue.full():
+                time.sleep(0.005)
+            wait_s = time.perf_counter() - t0
+            job.blocked_s += wait_s
+        with self._lock:
+            self._pending += 1
+            self._idle.clear()
+        self._queue.put(job)
+        return wait_s
+
+    def wait(self, timeout_s: Optional[float] = None) -> None:
+        """Drain: block until every submitted save has committed (or
+        failed — failures re-raise here)."""
+        if not self._idle.wait(timeout=timeout_s):
+            raise TimeoutError(
+                f"async checkpoint drain did not finish within {timeout_s}s"
+            )
+        self._raise_pending_error()
+
+    def close(self) -> None:
+        """Drain and stop the writer thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            self.wait()
+            self._queue.put(_STOP)
+            self._thread.join()
+        self._thread = None
+        self._raise_pending_error()
+
+    # ------------------------------------------------------------------ #
+    def _run(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is _STOP:
+                return
+            try:
+                self._write(job)
+            except BaseException as exc:  # noqa: BLE001 — captured, re-raised
+                logger.warning(
+                    f"background checkpoint write for {job.final_dir} "
+                    f"failed: {exc!r}"
+                )
+                commit_mod.discard_work_dir(job.work_dir)
+                with self._lock:
+                    self._error = exc
+            finally:
+                with self._lock:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._idle.set()
+
+    def _write(self, job: CheckpointJob) -> None:
+        from .. import dist_checkpoint
+        from ..checkpointing import _save_named, _write_host_state
+
+        t0 = time.perf_counter()
+        os.makedirs(job.work_dir, exist_ok=True)
+        nbytes = 0
+        if job.shard_snapshot is not None:
+            nbytes += dist_checkpoint.write_snapshot(
+                job.shard_snapshot, job.work_dir, fsync=True
+            )
+        for fname, named, safe in job.named_files:
+            _save_named(named, os.path.join(job.work_dir, fname), safe)
+            nbytes += sum(np.asarray(v).nbytes for v in named.values())
+        _write_host_state(job.host_files, job.work_dir)
+        commit_mod.commit(
+            job.work_dir,
+            job.final_dir,
+            job.process_index,
+            job.world,
+            timeout_s=job.barrier_timeout_s,
+        )
+        background_s = time.perf_counter() - t0
+        self.saves_completed += 1
+        if self.telemetry is not None:
+            self.telemetry.record_checkpoint(
+                step=job.step,
+                directory=job.final_dir,
+                mode="async",
+                blocked_s=job.blocked_s,
+                background_s=background_s,
+                bytes_written=nbytes,
+            )
+
+
+def save_accelerator_state_async(
+    accelerator,
+    checkpointer: AsyncCheckpointer,
+    output_dir: Optional[str] = None,
+    carry: Any = None,
+    params: Any = None,
+) -> str:
+    """Zero-stall counterpart of
+    :func:`accelerate_tpu.checkpointing.save_accelerator_state`.
+
+    The synchronous section is only: directory resolution/rotation, the
+    batched device->host snapshot of this process's shards, and the host
+    small-state capture. Serialization, disk IO, fsync and the commit all
+    happen on the writer thread — by the time the checkpoint is visible
+    on disk the train loop is several steps ahead. Returns the FINAL
+    directory the save will commit to (it does not exist yet when this
+    returns; call ``checkpointer.wait()`` to block on durability).
+    """
+    from ..checkpointing import (
+        _capture_host_state,
+        _checkpoint_dir,
+        _is_arraylike,
+        _to_host,
+        flatten_tree,
+    )
+    from ..dist_checkpoint import snapshot_tree
+
+    t0 = time.perf_counter()
+    checkpointer._raise_pending_error()
+    final_dir = _checkpoint_dir(accelerator, output_dir)
+    work_dir = commit_mod.work_dir_for(final_dir)
+    if accelerator.is_main_process:
+        commit_mod.discard_work_dir(work_dir)  # stale tmp from a crashed run
+    accelerator.wait_for_everyone()
+    logger.info(f"Async-saving current state to {final_dir}")
+
+    tree = carry if carry is not None else params
+    if tree is None and accelerator._models:
+        tree = accelerator._models[0]
+    snapshot = snapshot_tree(tree) if tree is not None else None
+
+    named_files = []
+    if carry is None:
+        from ..utils.constants import OPTIMIZER_NAME
+
+        for i, opt in enumerate(accelerator._optimizers):
+            if opt.opt_state is not None and accelerator.is_main_process:
+                named = flatten_tree(_to_host(opt.opt_state))
+                arrays = {k: v for k, v in named.items() if _is_arraylike(v)}
+                named_files.append(
+                    (f"{OPTIMIZER_NAME}_{i}.safetensors", arrays, True)
+                )
+
+    host_files = _capture_host_state(accelerator, carry)
+    accelerator.project_configuration.iteration += 1
+
+    job = CheckpointJob(
+        final_dir=final_dir,
+        work_dir=work_dir,
+        shard_snapshot=snapshot,
+        host_files=host_files,
+        named_files=named_files,
+        process_index=accelerator.process_index,
+        world=accelerator.num_processes,
+        step=accelerator.step,
+        blocked_s=0.0,
+        barrier_timeout_s=checkpointer.barrier_timeout_s,
+    )
+    job.blocked_s = time.perf_counter() - t0
+    queue_wait = checkpointer.submit(job)
+    if queue_wait > 0.01:
+        logger.info(
+            f"async checkpoint backpressure: waited {queue_wait:.2f}s for "
+            "the previous save to drain (disk slower than the cadence)"
+        )
+    return final_dir

@@ -105,16 +105,62 @@ def unflatten_into(template: Any, named: dict[str, Any]) -> Any:
 
 
 def _to_host(tree: Any) -> Any:
-    """Fetch every leaf to host numpy. Cross-host-sharded leaves are
-    all-gathered first (multi-process pods) so rank0 holds full arrays."""
-    def _fetch(x):
+    """Fetch every leaf to host numpy. Fully-addressable leaves come over
+    in ONE batched ``jax.device_get`` (a per-leaf ``np.asarray`` would pay
+    a round-trip per leaf); only leaves that are genuinely not addressable
+    from this process are all-gathered (multi-process pods) so rank0 holds
+    full arrays."""
+    leaves, treedef = jax.tree.flatten(tree)
+    out: list[Any] = []
+    batch_idx: list[int] = []
+    batch: list[jax.Array] = []
+    for i, x in enumerate(leaves):
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
             from jax.experimental import multihost_utils
 
-            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-        return np.asarray(x)
+            out.append(
+                np.asarray(multihost_utils.process_allgather(x, tiled=True))
+            )
+        elif isinstance(x, jax.Array):
+            batch_idx.append(i)
+            batch.append(x)
+            out.append(None)
+        else:
+            out.append(np.asarray(x))
+    if batch:
+        for i, host in zip(batch_idx, jax.device_get(batch)):
+            out[i] = np.asarray(host)
+    return jax.tree.unflatten(treedef, out)
 
-    return jax.tree.map(_fetch, tree)
+
+# ---------------------------------------------------------------------- #
+# atomic small-file io
+# ---------------------------------------------------------------------- #
+def _atomic_write(path: str, write_fn, mode: str = "w") -> None:
+    """Write via a same-dir tmp file + ``os.replace`` so a crash mid-write
+    can never leave a truncated file under the real name for a later
+    ``load_state`` to choke on."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _atomic_json_dump(obj: Any, path: str, **kwargs) -> None:
+    _atomic_write(path, lambda f: json.dump(obj, f, **kwargs))
+
+
+def _atomic_pickle_dump(obj: Any, path: str) -> None:
+    _atomic_write(path, lambda f: pickle.dump(obj, f), mode="wb")
 
 
 # ---------------------------------------------------------------------- #
@@ -128,8 +174,7 @@ def _save_named(named: dict[str, np.ndarray], path: str, safe: bool = True):
         named = {k: np.ascontiguousarray(v) for k, v in named.items()}
         save_file(named, path)
     else:
-        with open(path, "wb") as f:
-            pickle.dump(named, f)
+        _atomic_pickle_dump(named, path)
 
 def _load_named(path: str) -> dict[str, np.ndarray]:
     if path.endswith(".safetensors"):
@@ -261,12 +306,84 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str]) -> str:
     return output_dir
 
 def _list_checkpoints(base: str) -> list[str]:
+    """Complete (committed) checkpoints under ``base``, oldest first.
+
+    The name match is the commit protocol's read side: an in-flight or
+    crashed save only ever exists under ``checkpoint_<n>.tmp`` (see
+    :mod:`~accelerate_tpu.checkpoint_async.commit`), which the fullmatch
+    rejects — so restore never resumes from, and rotation never counts or
+    deletes, an uncommitted directory.
+    """
     entries = []
     for name in os.listdir(base):
         m = re.fullmatch(r"checkpoint_(\d+)", name)
         if m:
             entries.append((int(m.group(1)), os.path.join(base, name)))
     return [p for _, p in sorted(entries)]
+
+
+def _commit_mod():
+    """Lazy import of the commit-protocol module (checkpoint_async imports
+    this module's helpers back, so the dependency stays call-time)."""
+    from .checkpoint_async import commit
+
+    return commit
+
+
+def _capture_host_state(accelerator, carry: Any = None) -> list[tuple[str, str, Any]]:
+    """Snapshot the host-side small state as ``(filename, kind, payload)``
+    triples (``kind`` in ``{"json", "pickle"}``), captured NOW so an async
+    writer serializes exactly the state at save time, not whatever the
+    objects mutate to while the background write runs. Shared files are
+    main-process-only; the per-process RNG file is always captured."""
+    files: list[tuple[str, str, Any]] = []
+    if accelerator.is_main_process:
+        for i, sched in enumerate(accelerator._schedulers):
+            files.append(
+                (f"{SCHEDULER_NAME}_{i}.json", "json", _jsonable(sched.state_dict()))
+            )
+        for i, dl in enumerate(accelerator._dataloaders):
+            state = getattr(dl, "state_dict", lambda: None)()
+            if state is not None:
+                files.append((f"{SAMPLER_NAME}_{i}.json", "json", _jsonable(state)))
+        for i, obj in enumerate(accelerator._custom_objects):
+            files.append((f"{CUSTOM_STATE_NAME}_{i}.pkl", "pickle", obj.state_dict()))
+        if carry is not None and "opt_step" in carry:
+            # the carry's device counters are the source of truth
+            accelerator.sync_from_carry(carry)
+        meta = {
+            "step": accelerator.step,
+            "iteration": accelerator.project_configuration.iteration,
+            "version": 1,
+            "has_carry": carry is not None,
+            "num_optimizers": len(accelerator._optimizers),
+            "num_schedulers": len(accelerator._schedulers),
+            "num_dataloaders": len(accelerator._dataloaders),
+            "num_custom": len(accelerator._custom_objects),
+        }
+        files.append((METADATA_NAME, "json", meta))
+
+    # --- per-process RNG (reference checkpointing.py:134-148) ---
+    import random as _py_random
+
+    rng = {
+        "python": _py_random.getstate(),
+        "numpy": np.random.get_state(),
+        "keychain": accelerator.keys.state_dict(),
+    }
+    files.append((f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl", "pickle", rng))
+    return files
+
+
+def _write_host_state(files: list[tuple[str, str, Any]], output_dir: str) -> None:
+    """Write captured host state; every file lands atomically."""
+    for name, kind, payload in files:
+        path = os.path.join(output_dir, name)
+        if kind == "json":
+            indent = 2 if name == METADATA_NAME else None
+            _atomic_json_dump(payload, path, indent=indent)
+        else:
+            _atomic_pickle_dump(payload, path)
 
 def save_accelerator_state(
     accelerator,
@@ -289,11 +406,28 @@ def save_accelerator_state(
     utils/fsdp_utils.py:60-215), required for models that do not fit one
     host's RAM. ``sharded=False`` falls back to a rank-0 single-file
     export (all-gathers everything to every host first).
+
+    All files are written into ``<dir>.tmp`` and published by the atomic
+    commit protocol (:mod:`accelerate_tpu.checkpoint_async.commit`): a
+    crash at any point leaves only an invisible work dir, never a
+    half-written checkpoint that restore would pick up. For zero-stall
+    saves use :func:`accelerate_tpu.checkpoint_async.save_accelerator_state_async`,
+    which shares every phase of this function but runs the
+    serialization+IO on a background writer.
     """
-    output_dir = _checkpoint_dir(accelerator, output_dir)
-    os.makedirs(output_dir, exist_ok=True)
-    logger.info(f"Saving current state to {output_dir}")
+    import time as _time
+
+    t0 = _time.perf_counter()
+    final_dir = _checkpoint_dir(accelerator, output_dir)
+    commit = _commit_mod()
+    work_dir = commit.work_dir_for(final_dir)
+    if accelerator.is_main_process:
+        commit.discard_work_dir(work_dir)  # stale tmp from a crashed run
+    accelerator.wait_for_everyone()
+    os.makedirs(work_dir, exist_ok=True)
+    logger.info(f"Saving current state to {final_dir}")
     is_main = accelerator.is_main_process
+    nbytes = 0
 
     # --- the array state (one pytree, possibly cross-host sharded) ---
     tree = carry if carry is not None else params
@@ -301,9 +435,9 @@ def save_accelerator_state(
         tree = accelerator._models[0]
     if tree is not None:
         if sharded:
-            from .dist_checkpoint import save_sharded_tree
+            from .dist_checkpoint import snapshot_tree, write_snapshot
 
-            save_sharded_tree(tree, output_dir)
+            nbytes += write_snapshot(snapshot_tree(tree), work_dir, fsync=True)
         else:
             named = flatten_tree(_to_host(tree))
             if is_main:
@@ -311,11 +445,12 @@ def save_accelerator_state(
                 _save_named(
                     arrays,
                     os.path.join(
-                        output_dir,
+                        work_dir,
                         SAFE_WEIGHTS_NAME if safe_serialization else MODEL_NAME + ".bin",
                     ),
                     safe_serialization,
                 )
+                nbytes += sum(np.asarray(v).nbytes for v in arrays.values())
 
     # --- optimizer states not inside the carry (raw-loop usage) ---
     if carry is None:
@@ -324,54 +459,29 @@ def save_accelerator_state(
                 named = flatten_tree(_to_host(opt.opt_state))
                 arrays = {k: v for k, v in named.items() if _is_arraylike(v)}
                 _save_named(
-                    arrays, os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}.safetensors"), True
+                    arrays, os.path.join(work_dir, f"{OPTIMIZER_NAME}_{i}.safetensors"), True
                 )
+                nbytes += sum(np.asarray(v).nbytes for v in arrays.values())
 
-    # --- host-side small state ---
-    if is_main:
-        for i, sched in enumerate(accelerator._schedulers):
-            with open(os.path.join(output_dir, f"{SCHEDULER_NAME}_{i}.json"), "w") as f:
-                json.dump(_jsonable(sched.state_dict()), f)
-        for i, dl in enumerate(accelerator._dataloaders):
-            state = getattr(dl, "state_dict", lambda: None)()
-            if state is not None:
-                with open(os.path.join(output_dir, f"{SAMPLER_NAME}_{i}.json"), "w") as f:
-                    json.dump(_jsonable(state), f)
-        for i, obj in enumerate(accelerator._custom_objects):
-            with open(os.path.join(output_dir, f"{CUSTOM_STATE_NAME}_{i}.pkl"), "wb") as f:
-                pickle.dump(obj.state_dict(), f)
-        if carry is not None and "opt_step" in carry:
-            # the carry's device counters are the source of truth
-            accelerator.sync_from_carry(carry)
-        meta = {
-            "step": accelerator.step,
-            "iteration": accelerator.project_configuration.iteration,
-            "version": 1,
-            "has_carry": carry is not None,
-            "num_optimizers": len(accelerator._optimizers),
-            "num_schedulers": len(accelerator._schedulers),
-            "num_dataloaders": len(accelerator._dataloaders),
-            "num_custom": len(accelerator._custom_objects),
-        }
-        with open(os.path.join(output_dir, METADATA_NAME), "w") as f:
-            json.dump(meta, f, indent=2)
-
-    # --- per-process RNG (reference checkpointing.py:134-148) ---
-    import random as _py_random
-
-    rng = {
-        "python": _py_random.getstate(),
-        "numpy": np.random.get_state(),
-        "keychain": accelerator.keys.state_dict(),
-    }
-    with open(
-        os.path.join(output_dir, f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl"), "wb"
-    ) as f:
-        pickle.dump(rng, f)
+    # --- host-side small state (schedulers, samplers, custom, meta, RNG) ---
+    _write_host_state(_capture_host_state(accelerator, carry), work_dir)
 
     accelerator.project_configuration.iteration += 1
+    commit.commit(
+        work_dir, final_dir, accelerator.process_index, accelerator.num_processes
+    )
     accelerator.wait_for_everyone()
-    return output_dir
+    telemetry = getattr(accelerator, "telemetry", None)
+    if telemetry is not None:
+        telemetry.record_checkpoint(
+            step=accelerator.step,
+            directory=final_dir,
+            mode="sync",
+            blocked_s=_time.perf_counter() - t0,
+            background_s=0.0,
+            bytes_written=nbytes,
+        )
+    return final_dir
 
 def load_accelerator_state(
     accelerator,

@@ -29,6 +29,7 @@ natively, so no bit-casting is needed.
 
 from __future__ import annotations
 
+import dataclasses
 import glob
 import json
 import os
@@ -45,6 +46,23 @@ logger = get_logger(__name__)
 SHARD_FILE_PATTERN = "state_shard_{:05d}.safetensors"
 INDEX_FILE_PATTERN = "state_index_{:05d}.json"
 
+
+@dataclasses.dataclass
+class ShardSnapshot:
+    """A host-resident copy of this process's owned chunks: everything the
+    writer needs to produce the ``state_shard``/``state_index`` pair with
+    NO further device access — the handoff unit between the train-loop
+    snapshot (cheap, blocking) and the background serialization+IO
+    (expensive, hidden behind subsequent steps)."""
+
+    tensors: dict[str, np.ndarray]
+    manifest: dict[str, dict]
+    process_index: int
+
+    @property
+    def nbytes(self) -> int:
+        return sum(t.nbytes for t in self.tensors.values())
+
 def _normalize_index(index, shape) -> tuple[tuple[int, int], ...]:
     """A shard ``index`` (tuple of slices) -> ((start, stop), ...) with
     Nones resolved against the global shape."""
@@ -57,29 +75,30 @@ def _normalize_index(index, shape) -> tuple[tuple[int, int], ...]:
     return tuple(out)
 
 
-def save_sharded_tree(
-    tree: Any, output_dir: str, process_index: Optional[int] = None
-) -> None:
-    """Write this process's owned chunks of every leaf in ``tree``.
+def snapshot_tree(tree: Any, process_index: Optional[int] = None) -> ShardSnapshot:
+    """Device->host snapshot of this process's owned chunks of every leaf.
 
-    Every process must call this (it is collective only through the
-    filesystem); each writes its own pair of files. Leaves that are not
-    globally-sharded jax.Arrays (host numpy, python scalars, and — in a
-    multi-process run — process-local fully-addressable arrays, whose value
-    may differ per process) are owned by process 0: rank 0's copy wins,
-    matching the legacy rank-0 writer. Without this gate every process
-    would write an identical chunk for the same region and restore would
-    see overlapping coverage.
+    Ownership: leaves that are not globally-sharded jax.Arrays (host numpy,
+    python scalars, and — in a multi-process run — process-local
+    fully-addressable arrays, whose value may differ per process) are owned
+    by process 0: rank 0's copy wins, matching the legacy rank-0 writer.
+    Without this gate every process would write an identical chunk for the
+    same region and restore would see overlapping coverage.
+
+    All device shards are fetched in ONE batched ``jax.device_get`` — no
+    per-leaf transfers, no cross-host allgather, and host RAM holds only
+    this process's own shards. The returned snapshot references no device
+    memory, so it can be serialized on a background thread.
     """
     from .checkpointing import flatten_tree
 
     proc = jax.process_index() if process_index is None else process_index
     world = jax.process_count()
-    os.makedirs(output_dir, exist_ok=True)
     named = flatten_tree(tree)
 
     tensors: dict[str, np.ndarray] = {}
     manifest: dict[str, dict] = {}
+    pending: list[tuple[str, Any]] = []  # (stored key, device shard/array)
     fname = SHARD_FILE_PATTERN.format(proc)
     for key, leaf in named.items():
         if (
@@ -93,9 +112,8 @@ def save_sharded_tree(
             for i, shard in enumerate(leaf.addressable_shards):
                 if shard.replica_id != 0:
                     continue
-                data = np.asarray(shard.data)
                 stored = f"{key}@{i}"
-                tensors[stored] = np.ascontiguousarray(data)
+                pending.append((stored, shard.data))
                 bounds = _normalize_index(
                     shard.index, shape
                 ) if shard.index else ()
@@ -104,7 +122,7 @@ def save_sharded_tree(
                         "file": fname,
                         "stored": stored,
                         "offset": [b[0] for b in bounds],
-                        "shape": list(data.shape),
+                        "shape": list(shard.data.shape),
                     }
                 )
             if not chunks:
@@ -121,33 +139,82 @@ def save_sharded_tree(
                 continue  # non-tensor leaf (config objects etc.) — skipped,
                 # like the legacy path's _is_arraylike filter; restore keeps
                 # the template's value via strict=False
-            data = np.asarray(leaf)
-            if data.dtype.kind in "USO":  # strings / bytes / objects
-                continue
-            dtype = str(data.dtype)
             stored = f"{key}@0"
-            tensors[stored] = np.ascontiguousarray(data)
+            if isinstance(leaf, jax.Array):
+                data_shape, dtype = leaf.shape, str(leaf.dtype)
+                pending.append((stored, leaf))
+            else:
+                data = np.asarray(leaf)
+                if data.dtype.kind in "USO":  # strings / bytes / objects
+                    continue
+                data_shape, dtype = data.shape, str(data.dtype)
+                tensors[stored] = np.ascontiguousarray(data)
             manifest[key] = {
-                "shape": list(data.shape),
+                "shape": list(data_shape),
                 "dtype": dtype,
                 "chunks": [
                     {
                         "file": fname,
                         "stored": stored,
-                        "offset": [0] * data.ndim,
-                        "shape": list(data.shape),
+                        "offset": [0] * len(data_shape),
+                        "shape": list(data_shape),
                     }
                 ],
             }
 
+    if pending:
+        fetched = jax.device_get([arr for _, arr in pending])
+        for (stored, _), host in zip(pending, fetched):
+            tensors[stored] = np.ascontiguousarray(host)
+    return ShardSnapshot(tensors=tensors, manifest=manifest, process_index=proc)
+
+
+def write_snapshot(
+    snap: ShardSnapshot, output_dir: str, fsync: bool = False
+) -> int:
+    """Serialize a :class:`ShardSnapshot` into its ``state_shard`` /
+    ``state_index`` file pair — pure host IO, safe on a background thread.
+    The index is written via tmp + ``os.replace`` so a crash mid-write
+    never leaves a truncated manifest. Returns bytes written."""
     from safetensors.numpy import save_file
 
-    save_file(tensors, os.path.join(output_dir, fname))
-    with open(os.path.join(output_dir, INDEX_FILE_PATTERN.format(proc)), "w") as f:
-        json.dump(manifest, f)
-    logger.debug(
-        f"process {proc}: wrote {len(tensors)} chunks of {len(manifest)} leaves"
+    os.makedirs(output_dir, exist_ok=True)
+    fname = SHARD_FILE_PATTERN.format(snap.process_index)
+    shard_path = os.path.join(output_dir, fname)
+    save_file(snap.tensors, shard_path)
+    index_path = os.path.join(
+        output_dir, INDEX_FILE_PATTERN.format(snap.process_index)
     )
+    tmp = f"{index_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(snap.manifest, f)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, index_path)
+    if fsync:
+        fd = os.open(shard_path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    logger.debug(
+        f"process {snap.process_index}: wrote {len(snap.tensors)} chunks of "
+        f"{len(snap.manifest)} leaves"
+    )
+    return snap.nbytes
+
+
+def save_sharded_tree(
+    tree: Any, output_dir: str, process_index: Optional[int] = None
+) -> None:
+    """Write this process's owned chunks of every leaf in ``tree``
+    (snapshot + write in one synchronous call).
+
+    Every process must call this (it is collective only through the
+    filesystem); each writes its own pair of files.
+    """
+    write_snapshot(snapshot_tree(tree, process_index), output_dir)
 
 
 def is_sharded_checkpoint(input_dir: str) -> bool:

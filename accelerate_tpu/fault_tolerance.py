@@ -46,6 +46,16 @@ class CheckpointManager:
     thread only) that requests a final checkpoint instead of dying
     mid-write.
 
+    ``async_saves=True`` turns cadence saves into zero-stall async saves
+    (:mod:`accelerate_tpu.checkpoint_async`): ``step()`` blocks only for
+    the device->host snapshot; serialization, disk IO and the atomic
+    commit run on a background writer. The preemption contract stays
+    strict: on SIGTERM the manager DRAINS any in-flight background save,
+    then writes the final checkpoint synchronously — the final
+    checkpoint is durably committed before ``should_stop`` flips, and it
+    is the newest one, so restore resumes from it. ``max_pending`` bounds
+    queued background saves (backpressure, never dropped saves).
+
     Requires an accelerator configured with
     ``ProjectConfiguration(automatic_checkpoint_naming=True, project_dir=
     ...)`` — validated here so the failure is at construction, not at the
@@ -58,6 +68,8 @@ class CheckpointManager:
         every_n_steps: int = 500,
         handle_signals: bool = True,
         heartbeat=None,
+        async_saves: bool = False,
+        max_pending: int = 1,
     ):
         if every_n_steps < 1:
             raise ValueError("every_n_steps must be >= 1")
@@ -79,6 +91,15 @@ class CheckpointManager:
                 getattr(accelerator, "telemetry", None), "heartbeat", None
             )
         self.heartbeat = heartbeat
+        self.async_saves = async_saves
+        self._checkpointer = None
+        if async_saves:
+            from .checkpoint_async import AsyncCheckpointer
+
+            self._checkpointer = AsyncCheckpointer(
+                telemetry=getattr(accelerator, "telemetry", None),
+                max_pending=max_pending,
+            )
         self._count = 0
         self._preempted = threading.Event()
         self._preemption_logged = False
@@ -123,9 +144,12 @@ class CheckpointManager:
         return restored, True
 
     def step(self, carry: Any) -> Optional[str]:
-        """Call once per optimizer step. Saves on the cadence, or
-        immediately when preempted (then flags ``should_stop``). Returns
-        the checkpoint dir when one was written."""
+        """Call once per optimizer step. Saves on the cadence (async when
+        so configured), or immediately when preempted (then flags
+        ``should_stop``). Returns the checkpoint dir when a save was
+        started or written — for async saves the dir is the FINAL name
+        the background writer will commit to; call :meth:`wait` to block
+        on durability."""
         self._count += 1
         if self.heartbeat is not None:
             self.heartbeat.beat(self._count)
@@ -137,14 +161,39 @@ class CheckpointManager:
             )
         if not preempted and self._count % self.every_n_steps:
             return None
-        out = self.accelerator.save_state(carry=carry)
         if preempted:
+            # drain any in-flight background save FIRST (its commit must
+            # not race the final checkpoint's rotation), then write the
+            # final checkpoint synchronously: durable before should_stop
+            self.wait()
+            out = self.accelerator.save_state(carry=carry)
             self._stopped = True
             logger.warning(f"preemption checkpoint written to {out}")
-        return out
+            return out
+        if self.async_saves:
+            from .checkpoint_async import save_accelerator_state_async
+
+            return save_accelerator_state_async(
+                self.accelerator, self._checkpointer, carry=carry
+            )
+        return self.accelerator.save_state(carry=carry)
+
+    @property
+    def in_flight(self) -> bool:
+        """True while a background save has not committed yet."""
+        return self._checkpointer is not None and self._checkpointer.in_flight
+
+    def wait(self):
+        """Drain every in-flight background save (no-op in sync mode, or
+        when nothing is queued). Background write failures re-raise here."""
+        if self._checkpointer is not None:
+            self._checkpointer.wait()
 
     def close(self):
-        """Restore previous signal handlers (tests / nested use)."""
+        """Drain background saves and restore previous signal handlers
+        (tests / nested use)."""
+        if self._checkpointer is not None:
+            self._checkpointer.close()
         for sig, handler in self._prev_handlers.items():
             signal.signal(sig, handler)
         self._prev_handlers.clear()

@@ -18,6 +18,7 @@ overlap (acceptance: telemetry-off adds no per-step host sync).
 from __future__ import annotations
 
 import collections
+import threading
 import time
 from typing import Any, Optional, Union
 
@@ -87,6 +88,9 @@ class StepTelemetry:
         self._meta_written = False
         self._sink_errors = 0
         self._is_emitting_rank: Optional[bool] = None
+        # checkpoint records arrive from the background writer thread while
+        # step records come from the train loop — serialize sink writes
+        self._emit_lock = threading.Lock()
         if config.enabled and config.jsonl_path is not None:
             self.add_sink(JSONLSink(config.jsonl_path))
         if config.enabled and config.heartbeat:
@@ -117,10 +121,11 @@ class StepTelemetry:
         self.records.append(record)
         if not self.sinks or not self._should_emit():
             return
-        if not self._meta_written:
-            self._meta_written = True
-            self._emit_raw(self._meta_record())
-        self._emit_raw(record)
+        with self._emit_lock:
+            if not self._meta_written:
+                self._meta_written = True
+                self._emit_raw(self._meta_record())
+            self._emit_raw(record)
 
     def _emit_raw(self, record: dict) -> None:
         for sink in self.sinks:
@@ -299,6 +304,48 @@ class StepTelemetry:
         self._emit(record)
         return record
 
+    def record_checkpoint(
+        self,
+        *,
+        step: Optional[int] = None,
+        directory: Optional[str] = None,
+        mode: str = "sync",
+        blocked_s: Optional[float] = None,
+        background_s: Optional[float] = None,
+        bytes_written: Optional[int] = None,
+        **extra: Any,
+    ) -> Optional[dict]:
+        """Emit a ``kind="checkpoint"`` record — one committed save.
+
+        ``blocked_s`` is the seconds the TRAIN LOOP stalled for this save
+        (sync: the whole save; async: device->host snapshot + host-state
+        capture + any writer backpressure). ``background_s`` is the hidden
+        serialization+IO+commit time on the writer thread (0 for sync —
+        it all counts as blocked). Their separation is the async
+        subsystem's acceptance metric: async blocked_s must exclude IO.
+        Thread-safe: async saves report from the writer thread."""
+        if not self.enabled:
+            return None
+        record: dict[str, Any] = {
+            "kind": "checkpoint",
+            "label": "checkpoint",
+            "step": step,
+            "time_unix": time.time(),
+            "dir": directory,
+            "mode": mode,
+            "blocked_s": blocked_s,
+            "background_s": background_s,
+            "bytes_written": bytes_written,
+        }
+        io_s = background_s if mode == "async" else blocked_s
+        record["write_bandwidth_bytes_per_s"] = (
+            bytes_written / io_s if bytes_written and io_s else None
+        )
+        for key, value in extra.items():
+            record.setdefault(key, value)
+        self._emit(record)
+        return record
+
     # ------------------------------------------------------------------ #
     # reporting / lifecycle
     # ------------------------------------------------------------------ #
@@ -333,6 +380,15 @@ class StepTelemetry:
             ]
             if tps:
                 out["tokens_per_s_mean"] = float(np.mean(tps))
+        ckpts = [r for r in self.records if r.get("kind") == "checkpoint"]
+        if ckpts:
+            out["checkpoints"] = len(ckpts)
+            out["checkpoint_blocked_total_s"] = float(
+                sum(r.get("blocked_s") or 0.0 for r in ckpts)
+            )
+            out["checkpoint_background_total_s"] = float(
+                sum(r.get("background_s") or 0.0 for r in ckpts)
+            )
         if self.heartbeat is not None:
             out["stalls"] = self.heartbeat.stalls
         return out

@@ -48,6 +48,22 @@ Steps that paid compile cost additionally carry (from ``CompileMonitor``):
     persistent_cache_hits    int    cache hits during the compile
     persistent_cache_misses  int    cache misses during the compile
 
+``kind="checkpoint"`` (one per COMMITTED save; async saves emit from the
+background writer thread, after the commit rename)::
+
+    step                        int?   optimizer step the save captured
+    dir                         str    committed checkpoint directory
+    mode                        str    "sync" | "async"
+    blocked_s                   float  train-loop stall: sync = the whole
+                                       save; async = snapshot + host-state
+                                       capture + writer backpressure ONLY
+    background_s                float  hidden writer-thread time
+                                       (serialize + write + fsync +
+                                       commit); 0 for sync saves
+    bytes_written               int    this process's bytes on disk
+    write_bandwidth_bytes_per_s float? bytes / IO seconds (background_s
+                                       for async, blocked_s for sync)
+
 Fields marked ``?`` are null when not derivable; memory fields are absent
 on steps skipped by ``memory_interval``.
 """

@@ -65,6 +65,7 @@ def _configs(on_tpu: bool):
                 TransformerConfig.tiny(num_experts=4, num_experts_per_tok=2),
                 4, 128, 3, 1,
             ),
+            "ckpt": (TransformerConfig.tiny(), 4, 64, 8, 2),
         }
     dense = TransformerConfig(
         # ~916M params (Llama-8B width, depth cut to fit one 16G v5e chip
@@ -202,6 +203,19 @@ def _configs(on_tpu: bool):
         # variant so a slow/failed load can never cost the decode headline
         # (folded into the decode line's extra as load_s)
         "decode_load": (decode, 1, 0, 0, 0),
+        # checkpoint step-time perturbation, sync vs async saves. LAST so
+        # its disk IO (a ~1 GiB carry written 4x per mode) can never
+        # perturb the throughput headlines. Modest width: the metric is
+        # blocked-time per save, which only needs enough bytes that the
+        # serialize+write cost is unmistakable next to a step.
+        "ckpt": (
+            TransformerConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_layers=2, num_heads=16, num_kv_heads=8,
+                max_seq_len=512, dtype="bfloat16",
+            ),
+            8, 512, 16, 3,
+        ),
     }
 
 
@@ -284,6 +298,123 @@ def _mfu(cfg, n_params: int, seq: int, tokens_per_sec_chip: float) -> float:
     attn_flops_per_token = 6 * seq * cfg.num_heads * cfg.head_dim * cfg.num_layers
     flops_per_token = 6 * matmul_params + attn_flops_per_token
     return tokens_per_sec_chip * flops_per_token / _peak_flops(jax.devices()[0])
+
+
+def _run_ckpt(cfg, batch_size: int, seq: int, iters: int, warmup: int):
+    """Step-time perturbation of cadence checkpoints: sync vs async saves.
+
+    Runs the SAME train loop twice (fresh state each time), saving every
+    few steps through CheckpointManager — once synchronously, once through
+    the async subsystem — and reports the train-loop-blocked seconds per
+    save (the new ``kind="checkpoint"`` telemetry field) plus the step-time
+    spike a save adds on top of a quiet step. ``vs_baseline`` is
+    sync_blocked / async_blocked: >= 1 means async hides the IO.
+    """
+    import shutil
+    import tempfile
+
+    import optax
+
+    from accelerate_tpu import Accelerator, CheckpointManager, ProjectConfiguration
+    from accelerate_tpu.models import CausalLM, count_params
+
+    every_n = max(2, iters // 4)
+    out: dict[str, dict] = {}
+    n_params = 0
+    for mode in ("sync", "async"):
+        _reset_state()
+        project_dir = tempfile.mkdtemp(prefix=f"bench_ckpt_{mode}_")
+        try:
+            model = CausalLM(cfg)
+            acc = Accelerator(
+                mixed_precision="bf16",
+                project_config=ProjectConfiguration(
+                    project_dir=project_dir,
+                    automatic_checkpoint_naming=True,
+                    total_limit=2,
+                ),
+                telemetry=True,
+            )
+            params = acc.prepare(
+                model.init(
+                    jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+                )["params"]
+            )
+            n_params = count_params(params)
+            opt = acc.prepare(optax.adamw(3e-4))
+            carry = acc.init_carry(params, opt)
+            step = acc.unified_step(CausalLM.loss_fn(model))
+            ids = jnp.asarray(
+                np.random.default_rng(0).integers(
+                    0, cfg.vocab_size, (batch_size, seq)
+                ),
+                jnp.int32,
+            )
+            batch = {"input_ids": ids}
+            for _ in range(warmup):
+                carry, metrics = step(carry, batch)
+            np.asarray(metrics["loss"])
+
+            mgr = CheckpointManager(
+                acc, every_n_steps=every_n, handle_signals=False,
+                async_saves=(mode == "async"),
+            )
+            save_steps, quiet_steps = [], []
+            for i in range(1, iters + 1):
+                t0 = time.perf_counter()
+                carry, metrics = step(carry, batch)
+                np.asarray(metrics["loss"])  # step fully done before the save
+                saved = mgr.step(carry)
+                dt = time.perf_counter() - t0
+                (save_steps if saved else quiet_steps).append(dt)
+            mgr.wait()
+            mgr.close()
+            recs = [
+                r for r in acc.telemetry.records
+                if r.get("kind") == "checkpoint"
+            ]
+            out[mode] = {
+                "saves": len(recs),
+                "blocked_s": float(np.mean([r["blocked_s"] for r in recs])),
+                "background_s": float(
+                    np.mean([r["background_s"] for r in recs])
+                ),
+                "bytes_written": int(recs[-1]["bytes_written"]),
+                "write_bandwidth_gib_s": round(
+                    float(
+                        np.mean([
+                            r["write_bandwidth_bytes_per_s"] or 0.0
+                            for r in recs
+                        ])
+                    ) / 2**30,
+                    3,
+                ),
+                "save_step_s": float(np.mean(save_steps)),
+                "quiet_step_s": float(np.mean(quiet_steps)),
+                "save_step_overhead_s": float(
+                    np.mean(save_steps) - np.mean(quiet_steps)
+                ),
+            }
+        finally:
+            shutil.rmtree(project_dir, ignore_errors=True)
+
+    sync_b, async_b = out["sync"]["blocked_s"], out["async"]["blocked_s"]
+    return {
+        "metric": "ckpt_async_save_blocked_seconds",
+        "value": round(async_b, 4),
+        "unit": "s",
+        "vs_baseline": round(sync_b / async_b, 3) if async_b > 0 else None,
+        "extra": {
+            "sync": {k: round(v, 4) if isinstance(v, float) else v
+                     for k, v in out["sync"].items()},
+            "async": {k: round(v, 4) if isinstance(v, float) else v
+                      for k, v in out["async"].items()},
+            "every_n_steps": every_n,
+            "params": n_params,
+            "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
+            "batch": batch_size, "seq": seq,
+        },
+    }
 
 
 def _run_decode(cfg, batch_size: int, prompt_len: int, new_tokens: int,
@@ -480,6 +611,10 @@ def _result_line(name, cfg, batch_size, seq, iters, warmup,
     probe = _compile_probe()
     if name == "decode_load":
         rec = _run_decode_load(cfg)
+        rec["extra"].update(probe())
+        return rec
+    if name == "ckpt":
+        rec = _run_ckpt(cfg, batch_size, seq, iters, warmup)
         rec["extra"].update(probe())
         return rec
     if name == "decode":

@@ -1,0 +1,396 @@
+"""Async distributed checkpointing (accelerate_tpu.checkpoint_async):
+zero-stall saves, the atomic commit protocol, and crash-safety.
+
+The two acceptance properties from the subsystem's design:
+
+* async blocked time covers ONLY the device->host snapshot (+ host-state
+  capture + backpressure) — serialization and IO run hidden, and an
+  equivalent sync save is strictly slower in the blocked-time metric;
+* a failure (or kill) between snapshot and commit leaves no ``COMMITTED``
+  marker, and restore falls back to the previous committed checkpoint.
+"""
+
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, ProjectConfiguration, dist_checkpoint
+from accelerate_tpu.checkpoint_async import commit as commit_mod
+from accelerate_tpu.fault_tolerance import CheckpointManager
+
+
+def _fresh_singletons():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _setup(tmp_path, telemetry=False, total_limit=3):
+    _fresh_singletons()
+    pc = ProjectConfiguration(
+        project_dir=str(tmp_path), automatic_checkpoint_naming=True,
+        total_limit=total_limit,
+    )
+    acc = Accelerator(project_config=pc, telemetry=telemetry)
+    params = acc.prepare({"w": jnp.zeros((4, 4))})
+    opt = acc.prepare(optax.sgd(0.1))
+    carry = acc.init_carry(params, opt)
+    step = acc.unified_step(lambda p, b: jnp.mean((p["w"] - b["t"]) ** 2))
+    return acc, carry, step, {"t": jnp.ones((4, 4))}
+
+
+def _checkpoint_records(acc):
+    return [r for r in acc.telemetry.records if r.get("kind") == "checkpoint"]
+
+
+# ---------------------------------------------------------------------- #
+# commit protocol unit
+# ---------------------------------------------------------------------- #
+def test_commit_renames_work_dir_and_marks_committed(tmp_path):
+    final = str(tmp_path / "checkpoint_0")
+    work = commit_mod.work_dir_for(final)
+    assert work.endswith(commit_mod.TMP_SUFFIX)
+    assert commit_mod.is_work_dir(work) and not commit_mod.is_work_dir(final)
+    os.makedirs(work)
+    with open(os.path.join(work, "shard.bin"), "wb") as f:
+        f.write(b"data")
+    out = commit_mod.commit(work, final)
+    assert out == final
+    assert not os.path.exists(work)
+    assert commit_mod.is_committed(final)
+    with open(os.path.join(final, "shard.bin"), "rb") as f:
+        assert f.read() == b"data"
+
+
+def test_commit_replaces_existing_final_dir(tmp_path):
+    """Re-saving to an explicit output_dir must atomically swap the old
+    contents out, never merge into them or crash on the rename."""
+    final = str(tmp_path / "ckpt")
+    for payload in (b"old", b"new"):
+        work = commit_mod.work_dir_for(final)
+        os.makedirs(work)
+        with open(os.path.join(work, "shard.bin"), "wb") as f:
+            f.write(payload)
+        commit_mod.commit(work, final)
+    with open(os.path.join(final, "shard.bin"), "rb") as f:
+        assert f.read() == b"new"
+    assert commit_mod.is_committed(final)
+    # the backup swap dir must not survive the commit
+    assert [n for n in os.listdir(tmp_path) if ".old." in n] == []
+
+
+def test_done_marker_barrier_times_out_listing_missing_procs(tmp_path):
+    work = str(tmp_path / "checkpoint_0.tmp")
+    os.makedirs(work)
+    commit_mod.mark_done(work, 0)
+    with pytest.raises(TimeoutError, match="1"):
+        commit_mod.wait_for_done_markers(work, world=2, timeout_s=0.2)
+
+
+# ---------------------------------------------------------------------- #
+# async end-to-end
+# ---------------------------------------------------------------------- #
+def test_async_cadence_saves_commit_and_restore(tmp_path):
+    acc, carry, step, batch = _setup(tmp_path)
+    with CheckpointManager(
+        acc, every_n_steps=2, handle_signals=False, async_saves=True
+    ) as mgr:
+        started = []
+        for _ in range(6):
+            carry, _ = step(carry, batch)
+            out = mgr.step(carry)
+            if out:
+                started.append(out)
+        mgr.wait()
+        assert not mgr.in_flight
+    assert len(started) == 3  # steps 2, 4, 6
+    base = tmp_path / "checkpoints"
+    assert sorted(os.listdir(base)) == [
+        "checkpoint_0", "checkpoint_1", "checkpoint_2"
+    ]
+    for name in os.listdir(base):
+        assert commit_mod.is_committed(str(base / name))
+    w6 = np.asarray(carry["params"]["w"]).copy()
+
+    # "restart": fresh singletons + accelerator, resume from the async save
+    acc2, carry2, _, _ = _setup(tmp_path)
+    with CheckpointManager(acc2, handle_signals=False) as mgr2:
+        carry2, resumed = mgr2.restore_or_init(carry2)
+    assert resumed and acc2.step == 6
+    np.testing.assert_allclose(
+        np.asarray(carry2["params"]["w"]), w6, rtol=1e-6
+    )
+    assert int(np.asarray(carry2["opt_step"])) == 6
+
+
+def test_async_blocked_time_excludes_serialization_and_io(
+    tmp_path, monkeypatch
+):
+    """THE acceptance property: with the shard write slowed to SLOW
+    seconds, the async save's blocked_s (and the actual save_state wall
+    time) stay below SLOW while background_s absorbs it — and a sync save
+    of the same state is strictly slower in the blocked-time metric."""
+    SLOW = 0.25
+    acc, carry, step, batch = _setup(tmp_path, telemetry=True)
+    carry, _ = step(carry, batch)
+
+    real_write = dist_checkpoint.write_snapshot
+
+    def slow_write(snap, out_dir, fsync=False):
+        time.sleep(SLOW)
+        return real_write(snap, out_dir, fsync=fsync)
+
+    monkeypatch.setattr(dist_checkpoint, "write_snapshot", slow_write)
+
+    t0 = time.perf_counter()
+    acc.save_state(carry=carry, block=False)
+    wall = time.perf_counter() - t0
+    acc.wait_for_checkpoint()
+
+    rec_async = _checkpoint_records(acc)[-1]
+    assert rec_async["mode"] == "async"
+    assert wall < SLOW
+    assert rec_async["blocked_s"] < SLOW
+    assert rec_async["background_s"] >= SLOW
+    assert rec_async["bytes_written"] > 0
+
+    carry, _ = step(carry, batch)
+    acc.save_state(carry=carry)  # sync: pays the slow write in-line
+    rec_sync = _checkpoint_records(acc)[-1]
+    assert rec_sync["mode"] == "sync"
+    assert rec_sync["blocked_s"] >= SLOW
+    assert rec_sync["background_s"] == 0.0
+    assert rec_async["blocked_s"] < rec_sync["blocked_s"]
+
+    base = tmp_path / "checkpoints"
+    assert commit_mod.is_committed(str(base / "checkpoint_0"))
+    assert commit_mod.is_committed(str(base / "checkpoint_1"))
+
+
+def test_background_failure_discards_work_dir_and_restore_falls_back(
+    tmp_path, monkeypatch
+):
+    acc, carry, step, batch = _setup(tmp_path)
+    carry, _ = step(carry, batch)
+    acc.save_state(carry=carry)  # checkpoint_0, committed at step 1
+    carry, _ = step(carry, batch)
+
+    def boom(snap, out_dir, fsync=False):
+        raise RuntimeError("disk died")
+
+    monkeypatch.setattr(dist_checkpoint, "write_snapshot", boom)
+    acc.save_state(carry=carry, block=False)
+    with pytest.raises(RuntimeError, match="NOT committed"):
+        acc.wait_for_checkpoint()
+
+    base = tmp_path / "checkpoints"
+    # no COMMITTED-less checkpoint_1, and the .tmp work dir was discarded
+    assert sorted(os.listdir(base)) == ["checkpoint_0"]
+
+    acc2, carry2, _, _ = _setup(tmp_path)
+    with CheckpointManager(acc2, handle_signals=False) as mgr:
+        carry2, resumed = mgr.restore_or_init(carry2)
+    assert resumed and acc2.step == 1
+
+
+def test_uncommitted_tmp_invisible_to_restore_and_rotation(tmp_path):
+    from accelerate_tpu.checkpointing import _list_checkpoints
+
+    acc, carry, step, batch = _setup(tmp_path, total_limit=2)
+    base = tmp_path / "checkpoints"
+    os.makedirs(base)
+    # a crashed save from some earlier incarnation: data, no COMMITTED.
+    # checkpoint_7 sorts after everything this test writes, so rotation
+    # would pick it first if it leaked into the listing.
+    stale = base / "checkpoint_7.tmp"
+    os.makedirs(stale)
+    (stale / "state_shard_00000.safetensors").write_bytes(b"junk")
+
+    for i in range(3):  # total_limit=2 -> the 3rd save rotates the 1st out
+        carry, _ = step(carry, batch)
+        acc.save_state(carry=carry)
+    names = [os.path.basename(p) for p in _list_checkpoints(str(base))]
+    assert names == ["checkpoint_1", "checkpoint_2"]
+    # rotation deleted checkpoint_0 but never touched the in-flight tmp
+    assert stale.is_dir()
+    assert not (base / "checkpoint_0").exists()
+
+    acc2, carry2, _, _ = _setup(tmp_path)
+    with CheckpointManager(acc2, handle_signals=False) as mgr:
+        carry2, resumed = mgr.restore_or_init(carry2)
+    assert resumed and acc2.step == 3  # newest COMMITTED, not the tmp
+
+
+# ---------------------------------------------------------------------- #
+# satellites: batched _to_host, atomic small-file writes
+# ---------------------------------------------------------------------- #
+def test_to_host_batches_device_transfers_into_one_call(monkeypatch):
+    from accelerate_tpu import checkpointing
+
+    tree = {
+        "a": jnp.ones((3,)),
+        "b": {"c": jnp.arange(4.0), "d": np.full((2,), 7.0), "e": 3.5},
+    }
+    calls = []
+    real = jax.device_get
+
+    def counting(x):
+        calls.append(x)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    out = checkpointing._to_host(tree)
+    assert len(calls) == 1  # one batched transfer for BOTH device leaves
+    assert isinstance(out["a"], np.ndarray)
+    np.testing.assert_allclose(out["a"], np.ones((3,)))
+    np.testing.assert_allclose(out["b"]["c"], np.arange(4.0))
+    np.testing.assert_allclose(out["b"]["d"], np.full((2,), 7.0))
+    assert out["b"]["e"] == 3.5
+
+
+def test_atomic_json_dump_preserves_original_on_failure(tmp_path):
+    from accelerate_tpu.checkpointing import _atomic_json_dump
+
+    path = str(tmp_path / "accelerate_state.json")
+    _atomic_json_dump({"step": 7}, path)
+    with pytest.raises(TypeError):
+        _atomic_json_dump({"step": object()}, path)  # not JSON-able
+    with open(path) as f:
+        assert json.load(f) == {"step": 7}
+    assert [n for n in os.listdir(tmp_path) if ".tmp" in n] == []
+
+
+def test_atomic_pickle_dump_preserves_original_on_failure(tmp_path):
+    from accelerate_tpu.checkpointing import _atomic_pickle_dump
+
+    class Unpicklable:
+        def __reduce__(self):
+            raise ValueError("nope")
+
+    path = str(tmp_path / "custom_checkpoint_0.pkl")
+    _atomic_pickle_dump({"state": 1}, path)
+    with pytest.raises(Exception):
+        _atomic_pickle_dump(Unpicklable(), path)
+    with open(path, "rb") as f:
+        assert pickle.load(f) == {"state": 1}
+    assert [n for n in os.listdir(tmp_path) if ".tmp" in n] == []
+
+
+def test_snapshot_tree_holds_no_device_arrays(tmp_path):
+    """The snapshot handed to the writer thread must be pure host memory:
+    the writer never touches jax (device buffers there would also pin HBM
+    for the life of the queue)."""
+    tree = {
+        "w": jnp.arange(12.0).reshape(3, 4),
+        "scale": np.float32(2.0),
+        "step": 3,  # non-tensor: skipped by the shard format
+    }
+    snap = dist_checkpoint.snapshot_tree(tree)
+    assert all(type(t) is np.ndarray for t in snap.tensors.values())
+    assert snap.nbytes > 0
+    dist_checkpoint.write_snapshot(snap, str(tmp_path))
+    restored = dist_checkpoint.load_sharded_tree(
+        {"w": np.zeros((3, 4), np.float32), "scale": np.float32(0.0),
+         "step": 0},
+        str(tmp_path), strict=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(restored["w"]), np.arange(12.0).reshape(3, 4)
+    )
+
+
+# ---------------------------------------------------------------------- #
+# kill mid-save (the ckpt-smoke scenario): SIGKILL between snapshot and
+# commit -> no COMMITTED marker, restore lands on the last committed save
+# ---------------------------------------------------------------------- #
+_CHILD = r"""
+import os, signal, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax.numpy as jnp
+import optax
+
+import accelerate_tpu.dist_checkpoint as dist_checkpoint
+from accelerate_tpu import Accelerator, CheckpointManager, ProjectConfiguration
+
+# Slow down the THIRD save's shard write: its files land in the .tmp work
+# dir, then the writer sleeps before the commit rename — the SIGKILL below
+# arrives squarely in that window.
+real_write = dist_checkpoint.write_snapshot
+CALLS = {"n": 0}
+def gated(snap, out_dir, fsync=False):
+    CALLS["n"] += 1
+    r = real_write(snap, out_dir, fsync=fsync)
+    if CALLS["n"] >= 3:
+        time.sleep(60)
+    return r
+dist_checkpoint.write_snapshot = gated
+
+acc = Accelerator(project_config=ProjectConfiguration(
+    project_dir=sys.argv[1], automatic_checkpoint_naming=True))
+params = acc.prepare({"w": jnp.zeros((4, 4))})
+opt = acc.prepare(optax.sgd(0.1))
+carry = acc.init_carry(params, opt)
+step = acc.unified_step(lambda p, b: jnp.mean((p["w"] - b["t"]) ** 2))
+batch = {"t": jnp.ones((4, 4))}
+
+mgr = CheckpointManager(acc, every_n_steps=2, handle_signals=False,
+                        async_saves=True)
+for i in range(6):
+    carry, _ = step(carry, batch)
+    mgr.step(carry)
+# saves at steps 2 and 4 committed fast; the step-6 save is mid-write.
+# Wait for its work dir to exist, then die the hard way.
+work = os.path.join(sys.argv[1], "checkpoints", "checkpoint_2.tmp")
+deadline = time.time() + 30
+while not os.path.isdir(work) and time.time() < deadline:
+    time.sleep(0.01)
+time.sleep(0.3)  # let the tiny shard write finish: die in the sleep(60)
+print("KILLING", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+@pytest.mark.slow
+def test_kill_between_snapshot_and_commit_falls_back(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, str(tmp_path)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+    assert "KILLING" in proc.stdout
+
+    base = tmp_path / "checkpoints"
+    names = sorted(os.listdir(base))
+    # the interrupted save: work dir present, data written, NOT committed
+    assert "checkpoint_2.tmp" in names
+    assert not commit_mod.is_committed(str(base / "checkpoint_2.tmp"))
+    assert "checkpoint_2" not in names
+    for committed in ("checkpoint_0", "checkpoint_1"):
+        assert commit_mod.is_committed(str(base / committed))
+
+    # restore lands on the last COMMITTED checkpoint (step 4, not 6)
+    acc, carry, step, batch = _setup(tmp_path)
+    with CheckpointManager(acc, handle_signals=False) as mgr:
+        carry, resumed = mgr.restore_or_init(carry)
+    assert resumed and acc.step == 4
+
+    # the next save targets checkpoint_2 again: it must clear the stale
+    # tmp from the killed run and commit cleanly
+    carry, _ = step(carry, batch)
+    out = acc.save_state(carry=carry)
+    assert os.path.basename(out) == "checkpoint_2"
+    assert commit_mod.is_committed(out)
+    assert not (base / "checkpoint_2.tmp").exists()

@@ -87,6 +87,65 @@ def test_auto_resume_continues_from_checkpoint(tmp_path):
     assert int(np.asarray(carry2["opt_step"])) == 4
 
 
+def test_preemption_drains_inflight_async_save_then_writes_final(
+    tmp_path, monkeypatch
+):
+    """SIGTERM while a background save is still writing: the manager must
+    drain it (its commit cannot race the final checkpoint's rotation),
+    then write the final checkpoint synchronously — and restore resumes
+    from the FINAL checkpoint, not the drained cadence save."""
+    import time
+
+    from accelerate_tpu import dist_checkpoint
+    from accelerate_tpu.checkpoint_async import commit as commit_mod
+
+    acc, carry, step, batch = _setup(tmp_path)
+    real_write = dist_checkpoint.write_snapshot
+
+    def slow_write(snap, out_dir, fsync=False):
+        time.sleep(0.3)
+        return real_write(snap, out_dir, fsync=fsync)
+
+    monkeypatch.setattr(dist_checkpoint, "write_snapshot", slow_write)
+    with CheckpointManager(
+        acc, every_n_steps=2, async_saves=True
+    ) as mgr:
+        for _ in range(2):
+            carry, _ = step(carry, batch)
+            mgr.step(carry)  # step 2: async save now in flight (0.3s write)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert mgr.preempted
+        carry, _ = step(carry, batch)
+        out = mgr.step(carry)  # drain -> final sync checkpoint
+        assert out is not None and mgr.should_stop
+        assert not mgr.in_flight
+    base = tmp_path / "checkpoints"
+    assert sorted(os.listdir(base)) == ["checkpoint_0", "checkpoint_1"]
+    for name in os.listdir(base):
+        assert commit_mod.is_committed(str(base / name))
+
+    # restart: the FINAL (preemption) checkpoint is what resumes
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    pc = ProjectConfiguration(
+        project_dir=str(tmp_path), automatic_checkpoint_naming=True
+    )
+    acc2 = Accelerator(project_config=pc)
+    params2 = acc2.prepare({"w": jnp.zeros((4, 4))})
+    opt2 = acc2.prepare(optax.sgd(0.1))
+    carry2 = acc2.init_carry(params2, opt2)
+    with CheckpointManager(acc2, handle_signals=False) as mgr2:
+        carry2, resumed = mgr2.restore_or_init(carry2)
+    assert resumed and acc2.step == 3
+    np.testing.assert_allclose(
+        np.asarray(carry2["params"]["w"]),
+        np.asarray(carry["params"]["w"]), rtol=1e-6,
+    )
+
+
 def test_restore_or_init_without_checkpoints(tmp_path):
     acc, carry, step, batch = _setup(tmp_path)
     with CheckpointManager(acc, handle_signals=False) as mgr:
