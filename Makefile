@@ -28,9 +28,9 @@ FAST_FILES = \
   tests/test_data_loader.py tests/test_checkpointing.py \
   tests/test_ring_attention.py tests/test_seq2seq.py \
   tests/test_telemetry.py tests/test_compilation.py \
-  tests/test_checkpoint_async.py
+  tests/test_checkpoint_async.py tests/test_fused_accum.py
 
-.PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke
+.PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -59,3 +59,13 @@ ckpt-smoke:
 	$(PYTEST) -q \
 	  tests/test_checkpoint_async.py::test_kill_between_snapshot_and_commit_falls_back \
 	  tests/test_checkpoint_async.py::test_async_blocked_time_excludes_serialization_and_io
+
+# fused-accumulation acceptance on CPU: the fp32 bitwise parity test
+# (fused lax.scan == per-microbatch lax.cond after 3 optimizer steps)
+# plus the K=8 fused-vs-unfused bench variant (dispatches 1 vs 8,
+# fused per-opt-step wall time <= unfused)
+accum-smoke:
+	$(PYTEST) -q \
+	  tests/test_fused_accum.py::test_fused_parity_fp32_bitwise \
+	  tests/test_fused_accum.py::test_fused_zero_retraces_after_warmup
+	python bench.py accum

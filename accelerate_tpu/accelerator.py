@@ -340,7 +340,10 @@ class Accelerator:
     prepare_model = prepare_params
 
     def prepare_data_loader(
-        self, dataloader: Any, dispatch_batches: Optional[bool] = None
+        self,
+        dataloader: Any,
+        dispatch_batches: Optional[bool] = None,
+        superbatch: Optional[int] = None,
     ) -> DataLoaderShard:
         if isinstance(dataloader, DataLoaderShard):
             dataloader.telemetry = self.telemetry
@@ -351,10 +354,17 @@ class Accelerator:
             import dataclasses as _dc
 
             config = _dc.replace(config, dispatch_batches=dispatch_batches)
+        if superbatch is None:
+            # fused accumulation consumes stacked [K, micro, ...] batches:
+            # prepare the loader in superbatch mode automatically so
+            # unified_step(fused_accumulation=True) and prepare() compose
+            gs = self.gradient_state
+            superbatch = gs.num_steps if (gs.fused and gs.num_steps > 1) else 1
         prepared = prepare_data_loader(
             dataloader,
             self.state,
             config,
+            superbatch=superbatch,
         )
         # the loader reports time the loop spent blocked on q.get() so
         # step records separate input-starvation from compute
@@ -390,18 +400,42 @@ class Accelerator:
         max_grad_norm: Optional[float] = None,
         has_aux: bool = False,
         donate: bool = True,
+        fused_accumulation: Optional[bool] = None,
+        remat_policy: Any = None,
     ) -> Callable:
         """Build THE train step: one jitted XLA program containing forward,
         backward, accumulation, clipping and update.
 
         ``loss_fn(params, batch, **kw) -> loss`` (or ``(loss, aux)`` with
         ``has_aux``) is the user's raw loop body. Compute runs in the mixed-
-        precision compute dtype; params/opt-state stay fp32. Gradients are
-        accumulated into a carried fp32 buffer; every ``num_steps``-th call
-        crosses the sync boundary: unscale (fp16), clip to ``max_grad_norm``,
-        optimizer update — all under lax.cond so both phases are one compiled
-        program. GSPMD inserts the gradient reduce-scatter/all-reduce implied
-        by the param/batch shardings; we never call a collective.
+        precision compute dtype; params/opt-state stay fp32. GSPMD inserts
+        the gradient reduce-scatter/all-reduce implied by the param/batch
+        shardings; we never call a collective.
+
+        Two accumulation execution modes (``GradientState.num_steps = K``):
+
+        * **unfused** (default): the step is dispatched once per MICROBATCH;
+          gradients accumulate into a carried fp32 buffer and every K-th call
+          crosses the sync boundary — unscale (fp16), clip to
+          ``max_grad_norm``, optimizer update — under ``lax.cond`` so both
+          phases are one compiled program.
+        * **fused** (``fused_accumulation=True``, or
+          ``GradientAccumulationPlugin(fused=True)`` /
+          ``ACCELERATE_TPU_FUSED_ACCUM``): ONE dispatch per OPTIMIZER step.
+          The step takes a **stacked** batch of shape ``[K, micro, ...]``
+          (the prepared dataloader's superbatch mode collates it) and runs
+          forward+backward+accumulate under ``lax.scan`` over the leading
+          axis, with the unscale/clip/update epilogue executed once per
+          call — no ``lax.cond``, no accumulation buffer carried across
+          calls, no ``micro_step`` bookkeeping in the carry. XLA sees the
+          whole optimizer step as one program, so it can overlap the final
+          microbatch's backward with the gradient reduction.
+
+        ``remat_policy`` (fused path) threads ``jax.checkpoint`` around the
+        per-microbatch loss so activation memory stays at one-microbatch
+        scale: ``True`` for full rematerialization, or any
+        ``jax.checkpoint_policies`` policy for selective saving (compute
+        cost: the backward re-runs the non-saved forward ops).
 
         Returns ``step_fn(carry, batch, **kw) -> (carry, metrics)`` where
         ``carry = accelerator.init_carry(params, optimizer)``.
@@ -411,6 +445,12 @@ class Accelerator:
             raise ValueError("prepare() an optimizer before building the step")
         policy = self.state.mixed_precision_policy
         num_accum = self.gradient_state.num_steps
+        fused = (
+            self.gradient_state.fused
+            if fused_accumulation is None
+            else fused_accumulation
+        )
+        fused = fused and num_accum > 1  # K=1 already has no cond/buffer
         opt_transform = optimizer.optimizer
         # Pin the output param/opt-state shardings to the parallelism plan:
         # without this, GSPMD propagation may reshard outputs to follow other
@@ -429,6 +469,112 @@ class Accelerator:
                 else None
             )
 
+        def _sync_apply(accum, opt_state, params, ls):
+            """The once-per-optimizer-step epilogue: mean, unscale/overflow-
+            check (fp16), clip, update, sharding pins, GradScaler skip.
+            Shared verbatim by the unfused cond branch and the fused scan
+            path so the two modes are arithmetically identical."""
+            mean_grads = jax.tree.map(lambda a: a / num_accum, accum)
+            mean_grads, finite, new_ls = unscale_and_check(
+                mean_grads, ls, policy
+            )
+            if max_grad_norm is not None:
+                gnorm = optax.global_norm(mean_grads)
+                scale_c = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                mean_grads = jax.tree.map(lambda g: g * scale_c, mean_grads)
+            else:
+                gnorm = optax.global_norm(mean_grads)
+            updates, new_opt_state = opt_transform.update(
+                mean_grads, opt_state, params
+            )
+            new_params = optax.apply_updates(params, updates)
+            # self._param_shardings read at trace time for the same
+            # build-order reason as _opt_shardings
+            new_params = _pin_to_shardings(new_params, self._param_shardings)
+            new_opt_state = _pin_to_shardings(new_opt_state, _opt_shardings())
+            # fp16 overflow: keep old params/state (GradScaler skip)
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_params, params
+            )
+            new_opt_state = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_opt_state, opt_state
+            )
+            return new_params, new_opt_state, new_ls, gnorm, finite
+
+        # accumulate in grad_dtype (default fp32; bf16 halves the accum
+        # buffer HBM at some precision cost — the comm-hook tradeoff)
+        accum_dtype = jnp.dtype(policy.grad_dtype or jnp.float32)
+
+        def _fused_step(carry: dict, batch: Any, **kw):
+            if "accum_grads" in carry or "micro_step" in carry:
+                raise ValueError(
+                    "fused accumulation carries no accum_grads/micro_step — "
+                    "build the carry with init_carry on an accelerator whose "
+                    "GradientAccumulationPlugin has fused=True (or pass "
+                    "fused_accumulation=True to init_carry)"
+                )
+            params = carry["params"]
+            opt_state = carry["opt_state"]
+            ls = carry.get("loss_scale")
+            compute_params = _cast_floating(params, policy.compute_dtype)
+
+            def _micro_loss(p, b):
+                out = loss_fn(p, b, **kw)
+                loss = out[0] if has_aux else out
+                aux = out[1] if has_aux else None
+                return scale_loss(loss.astype(jnp.float32), ls), (loss, aux)
+
+            if remat_policy is not None:
+                # activation memory stays at one-microbatch scale: backward
+                # recomputes the (non-saved) forward per scan iteration
+                ckpt_kw = {} if remat_policy is True else {"policy": remat_policy}
+                _micro_loss = jax.checkpoint(_micro_loss, **ckpt_kw)
+
+            zero2 = self._zero2_grad_shardings(params)
+
+            def _body(acc, micro_batch):
+                compute_batch = _cast_floating(micro_batch, policy.compute_dtype)
+                grads, (loss, aux) = jax.grad(
+                    lambda p: _micro_loss(p, compute_batch), has_aux=True
+                )(compute_params)
+                grads = _cast_floating(grads, accum_dtype)
+                acc = jax.tree.map(lambda a, g: a + g, acc, grads)
+                if zero2 is not None:
+                    # ZeRO-2: pin the scan carry to its fsdp shards so the
+                    # grad sum lowers to reduce-scatter, not all-reduce
+                    acc = jax.tree.map(
+                        jax.lax.with_sharding_constraint, acc, zero2
+                    )
+                return acc, (loss.astype(jnp.float32), aux)
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(jnp.shape(p), accum_dtype), params
+            )
+            accum, (losses, auxes) = jax.lax.scan(_body, zeros, batch)
+            params, opt_state, ls, gnorm, finite = _sync_apply(
+                accum, opt_state, params, ls
+            )
+            new_carry = {
+                "params": params,
+                "opt_state": opt_state,
+                "opt_step": carry["opt_step"] + 1,
+            }
+            if ls is not None:
+                new_carry["loss_scale"] = ls
+            metrics = {
+                # scalar mean for charts; the per-microbatch vector keeps
+                # loss curves at microbatch resolution (and lets callers
+                # mask padded tail microbatches via the loader's remainder)
+                "loss": jnp.mean(losses),
+                "loss_per_microbatch": losses,
+                "grad_norm": gnorm,
+                "grads_finite": finite,
+                "is_sync_step": jnp.asarray(True),
+            }
+            if has_aux and auxes is not None:
+                metrics["aux"] = auxes
+            return new_carry, metrics
+
         def _step(carry: dict, batch: Any, **kw):
             params = carry["params"]
             opt_state = carry["opt_state"]
@@ -444,12 +590,13 @@ class Accelerator:
                 aux = out[1] if has_aux else None
                 return scale_loss(loss.astype(jnp.float32), ls), (loss, aux)
 
+            if remat_policy is not None:
+                ckpt_kw = {} if remat_policy is True else {"policy": remat_policy}
+                _scaled_loss = jax.checkpoint(_scaled_loss, **ckpt_kw)
+
             grads, (loss, aux) = jax.grad(
                 lambda p: _scaled_loss(p, compute_batch), has_aux=True
             )(compute_params)
-            # accumulate in grad_dtype (default fp32; bf16 halves the accum
-            # buffer HBM at some precision cost — the comm-hook tradeoff)
-            accum_dtype = jnp.dtype(policy.grad_dtype or jnp.float32)
             grads = _cast_floating(grads, accum_dtype)
             if num_accum > 1:
                 accum = jax.tree.map(lambda a, g: a + g, carry["accum_grads"], grads)
@@ -467,30 +614,8 @@ class Accelerator:
 
             def _apply(operand):
                 accum, opt_state, params, ls = operand
-                mean_grads = jax.tree.map(lambda a: a / num_accum, accum)
-                mean_grads, finite, new_ls = unscale_and_check(
-                    mean_grads, ls, policy
-                )
-                if max_grad_norm is not None:
-                    gnorm = optax.global_norm(mean_grads)
-                    scale_c = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
-                    mean_grads = jax.tree.map(lambda g: g * scale_c, mean_grads)
-                else:
-                    gnorm = optax.global_norm(mean_grads)
-                updates, new_opt_state = opt_transform.update(
-                    mean_grads, opt_state, params
-                )
-                new_params = optax.apply_updates(params, updates)
-                # self._param_shardings read at trace time for the same
-                # build-order reason as _opt_shardings
-                new_params = _pin_to_shardings(new_params, self._param_shardings)
-                new_opt_state = _pin_to_shardings(new_opt_state, _opt_shardings())
-                # fp16 overflow: keep old params/state (GradScaler skip)
-                new_params = jax.tree.map(
-                    lambda n, o: jnp.where(finite, n, o), new_params, params
-                )
-                new_opt_state = jax.tree.map(
-                    lambda n, o: jnp.where(finite, n, o), new_opt_state, opt_state
+                new_params, new_opt_state, new_ls, gnorm, finite = _sync_apply(
+                    accum, opt_state, params, ls
                 )
                 zeroed = jax.tree.map(jnp.zeros_like, accum)
                 return (zeroed, new_opt_state, new_params, new_ls, gnorm, finite)
@@ -502,7 +627,10 @@ class Accelerator:
                     opt_state,
                     params,
                     ls,
-                    jnp.asarray(0.0, jnp.float32),
+                    # no gradient norm exists on a non-sync microbatch step;
+                    # NaN (not 0.0) so charts/trackers can never mistake it
+                    # for a real collapsed-gradient reading
+                    jnp.asarray(jnp.nan, jnp.float32),
                     jnp.asarray(True),
                 )
 
@@ -539,7 +667,7 @@ class Accelerator:
         donate_args = (0,) if (donate and self.compile_plugin.donate_state) else ()
         static_names = tuple(self.compile_plugin.static_argnames)
         jitted = jax.jit(
-            _step,
+            _fused_step if fused else _step,
             donate_argnums=donate_args,
             static_argnames=static_names or None,
         )
@@ -547,7 +675,17 @@ class Accelerator:
         # legitimately see different signatures without cross-talk warnings
         tel_label = f"unified_step#{self._built_steps}"
         self._built_steps += 1
-        return self._wrap_step(jitted, tel_label, sync_every=num_accum)
+        if fused:
+            # every call IS an optimizer step: one dispatch covers all K
+            # microbatches, so the wrapper emits one record per opt step
+            return self._wrap_step(
+                jitted, tel_label, sync_every=1,
+                microbatches=num_accum, dispatches=1,
+            )
+        return self._wrap_step(
+            jitted, tel_label, sync_every=num_accum,
+            microbatches=1, dispatches=num_accum,
+        )
 
     def unified_pipeline_step(
         self,
@@ -668,10 +806,21 @@ class Accelerator:
         jitted = jax.jit(_step, donate_argnums=donate_args)
         tel_label = f"unified_pipeline_step#{self._built_steps}"
         self._built_steps += 1
-        # every pipeline step is an optimizer step -> sync_every=1
-        return self._wrap_step(jitted, tel_label, sync_every=1)
+        # every pipeline step is an optimizer step -> sync_every=1; the 1F1B
+        # schedule IS the microbatching, folded into the single dispatch
+        return self._wrap_step(
+            jitted, tel_label, sync_every=1, microbatches=num_micro, dispatches=1
+        )
 
-    def _wrap_step(self, jitted, tel_label: str, *, sync_every: int) -> Callable:
+    def _wrap_step(
+        self,
+        jitted,
+        tel_label: str,
+        *,
+        sync_every: int,
+        microbatches: int = 1,
+        dispatches: int = 1,
+    ) -> Callable:
         """The shared step-fn wrapper: host-mirror bookkeeping, telemetry,
         compile-cost attribution, and the AOT warmup fast path.
 
@@ -745,6 +894,13 @@ class Accelerator:
                     step=self.step, metrics=out[1],
                     retraced=retraced, label=tel_label,
                     compile_stats=delta if (retraced or compiled_now) else None,
+                    # the perf shape of this step fn: how many microbatches
+                    # one record covers and how many dispatches one
+                    # optimizer step costs (fused accumulation: K and 1)
+                    extra={
+                        "microbatches": microbatches,
+                        "dispatches_per_opt_step": dispatches,
+                    },
                 )
             return out
 
@@ -819,23 +975,39 @@ class Accelerator:
         return warm(*args, **kw)
 
     def init_carry(
-        self, params: Any, optimizer: Optional[AcceleratedOptimizer] = None
+        self,
+        params: Any,
+        optimizer: Optional[AcceleratedOptimizer] = None,
+        fused_accumulation: Optional[bool] = None,
     ) -> dict:
         """Build the train-step carry (params + opt state + accum buffers +
-        counters [+ loss scale]) with shardings congruent to params."""
+        counters [+ loss scale]) with shardings congruent to params.
+
+        ``fused_accumulation`` must match the mode the step was built with
+        (``None`` resolves from the plugin, same as ``unified_step``): the
+        fused carry holds no ``micro_step`` counter and no ``accum_grads``
+        buffer — accumulation lives entirely inside the scanned program.
+        """
         optimizer = optimizer or (self._optimizers[0] if self._optimizers else None)
         if optimizer is None:
             raise ValueError("prepare() an optimizer before init_carry")
         if optimizer.opt_state is None:
             optimizer.init(params)
         policy = self.state.mixed_precision_policy
+        fused = (
+            self.gradient_state.fused
+            if fused_accumulation is None
+            else fused_accumulation
+        )
+        fused = fused and self.gradient_state.num_steps > 1
         carry = {
             "params": params,
             "opt_state": optimizer.opt_state,
-            "micro_step": jnp.asarray(0, jnp.int32),
             "opt_step": jnp.asarray(0, jnp.int32),
         }
-        if self.gradient_state.num_steps > 1:
+        if not fused:
+            carry["micro_step"] = jnp.asarray(0, jnp.int32)
+        if self.gradient_state.num_steps > 1 and not fused:
             accum_dtype = jnp.dtype(policy.grad_dtype or jnp.float32)
             zeros = lambda p: jax.tree.map(
                 lambda x: jnp.zeros_like(x, dtype=accum_dtype), p
@@ -870,10 +1042,15 @@ class Accelerator:
         """Force host mirrors (``step``, ``sync_gradients``) to the carry's
         device counters. One host read — call on checkpoint/log boundaries
         when the call-count mirror may be stale (e.g. after load_state)."""
-        micro = int(np.asarray(carry["micro_step"]))
         opt = int(np.asarray(carry["opt_step"]))
-        self.step = opt * self.gradient_state.num_steps + micro
-        self.gradient_state.sync_gradients = micro == 0
+        if "micro_step" in carry:
+            micro = int(np.asarray(carry["micro_step"]))
+            self.step = opt * self.gradient_state.num_steps + micro
+            self.gradient_state.sync_gradients = micro == 0
+        else:
+            # fused carry: every dispatch IS an optimizer step
+            self.step = opt
+            self.gradient_state.sync_gradients = True
 
     # ------------------------------------------------------------------ #
     # raw-loop parity API (eager path)

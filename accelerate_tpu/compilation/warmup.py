@@ -60,8 +60,11 @@ def batch_spec_of(source: Any) -> Any:
     """Batch spec from a prepared dataloader (or any batch-like pytree).
 
     A ``DataLoaderShard`` knows its fixed padded global batch shape
-    (``.batch_spec()``); a concrete batch (the output of one loader
-    step, or a hand-built pytree of arrays) is abstracted leaf-by-leaf.
+    (``.batch_spec()``) — in superbatch mode that spec is the stacked
+    ``[K, global_batch, ...]`` shape the fused-accumulation step consumes,
+    so warming from the loader covers the fused program too; a concrete
+    batch (the output of one loader step, or a hand-built pytree of
+    arrays) is abstracted leaf-by-leaf.
     """
     spec_fn = getattr(source, "batch_spec", None)
     if callable(spec_fn):

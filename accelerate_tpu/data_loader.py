@@ -255,6 +255,39 @@ def _sharding_data_degree(sharding) -> int:
     return degree
 
 
+def _stack_superbatches(
+    source: Iterator[tuple[Any, int]], k: int
+) -> Iterator[tuple[Any, int]]:
+    """Collate every ``k`` consecutive microbatches into ONE stacked
+    ``[k, micro, ...]`` host batch — the input contract of the fused
+    gradient-accumulation step (``unified_step(fused_accumulation=True)``),
+    which ``lax.scan``s over the leading axis instead of being dispatched
+    ``k`` times.
+
+    A partial final group is padded by repeating its last microbatch so
+    the stacked shape stays static for XLA; ``valid`` carries the TRUE
+    global sample count summed across the k slots, so remainder tracking
+    and loss masking can drop the padding.
+    """
+    group: list[Any] = []
+    valid_total = 0
+    for host_batch, valid in source:
+        group.append(_to_numpy(host_batch))
+        valid_total += valid
+        if len(group) == k:
+            yield _stack_group(group), valid_total
+            group, valid_total = [], 0
+    if group:
+        # pad-and-mask: repeat the last microbatch to fill the stack
+        while len(group) < k:
+            group.append(group[-1])
+        yield _stack_group(group), valid_total
+
+
+def _stack_group(group: list[Any]) -> Any:
+    return jax.tree.map(lambda *xs: np.stack(xs), *group)
+
+
 def _default_collate(items: list[Any]) -> Any:
     """Stack a list of samples into a batch pytree."""
     first = items[0]
@@ -293,12 +326,18 @@ class DataLoaderShard(DataLoaderStateMixin):
         prefetch_size: int = 2,
         rng_synchronizer: Optional[Callable[[], None]] = None,
         sampler=None,
+        superbatch: int = 1,
         _skip_batches: int = 0,
     ):
         self._factory = batch_iter_factory
         self._num_batches = num_batches
         self.sharding = sharding
         self.global_batch_size = global_batch_size
+        # superbatch=K: stack K consecutive microbatches into one
+        # [K, micro, ...] device batch for the fused-accumulation step.
+        # The K axis is replicated; the batch axis (now axis 1) keeps the
+        # data sharding. global_batch_size stays the per-MICROBATCH size.
+        self.superbatch = max(1, int(superbatch))
         self.prefetch_size = max(1, prefetch_size)
         self._rng_synchronizer = rng_synchronizer
         self.sampler = sampler
@@ -329,12 +368,22 @@ class DataLoaderShard(DataLoaderStateMixin):
     def total_batch_size(self) -> int:
         return self.global_batch_size
 
+    def _stacked_sharding(self):
+        """Sharding of a [K, micro, ...] superbatch: leading K axis
+        replicated (every device scans all K slots), batch axis keeps the
+        data-parallel split — GSPMD then propagates it through lax.scan."""
+        return jax.sharding.NamedSharding(
+            self.sharding.mesh,
+            jax.sharding.PartitionSpec(None, *tuple(self.sharding.spec)),
+        )
+
     def batch_spec(self) -> Any:
         """Abstract spec of one global device batch: a pytree of
         ``jax.ShapeDtypeStruct`` with the shardings :meth:`__iter__` would
         commit — the AOT-warmup contract (``accelerator.warmup``). Every
         batch is padded to one fixed shape, so the first batch's spec is
-        THE spec.
+        THE spec. In superbatch mode the spec gains the leading stacked
+        ``K`` axis (the shape the fused step is compiled for).
 
         Collates one host batch from a fresh iterator to read the shapes
         (no device transfer, no training-iterator state touched)."""
@@ -350,15 +399,23 @@ class DataLoaderShard(DataLoaderStateMixin):
         host_batch = _to_numpy(host_batch)
         num_processes = jax.process_count()
         data_degree = _sharding_data_degree(self.sharding)
+        k = self.superbatch
 
         def _spec(x):
-            # mirror _device_put's placement decisions exactly
+            # mirror _device_put's placement decisions exactly; the factory
+            # yields microbatches, so in superbatch mode prepend the K axis
             x = np.asarray(x)
             if x.ndim == 0 or (x.shape[0] * num_processes) % data_degree != 0:
                 replicated = jax.sharding.NamedSharding(
                     self.sharding.mesh, jax.sharding.PartitionSpec()
                 )
-                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=replicated)
+                shape = (k,) + x.shape if k > 1 else x.shape
+                return jax.ShapeDtypeStruct(shape, x.dtype, sharding=replicated)
+            if k > 1:
+                global_shape = (k, x.shape[0] * num_processes) + x.shape[1:]
+                return jax.ShapeDtypeStruct(
+                    global_shape, x.dtype, sharding=self._stacked_sharding()
+                )
             global_shape = (x.shape[0] * num_processes,) + x.shape[1:]
             return jax.ShapeDtypeStruct(global_shape, x.dtype, sharding=self.sharding)
 
@@ -369,7 +426,11 @@ class DataLoaderShard(DataLoaderStateMixin):
     def __len__(self) -> int:
         if self._num_batches is None:
             raise TypeError("this dataloader has no length")
-        return max(0, self._num_batches - self._skip_batches)
+        n = self._num_batches
+        if self.superbatch > 1:
+            # the factory counts microbatches; we yield stacked superbatches
+            n = math.ceil(n / self.superbatch)
+        return max(0, n - self._skip_batches)
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -377,25 +438,47 @@ class DataLoaderShard(DataLoaderStateMixin):
             self.sampler.set_epoch(epoch)
 
     def _device_put(self, host_batch: Any, valid: int) -> Any:
-        """Host numpy pytree -> global sharded jax.Array pytree."""
+        """Host numpy pytree -> global sharded jax.Array pytree.
+
+        In superbatch mode ``host_batch`` arrives already stacked
+        ``[K, micro, ...]`` (the producer ran :func:`_stack_superbatches`),
+        so the batch dim is axis 1 and the K axis is replicated."""
         num_processes = jax.process_count()
         data_degree = _sharding_data_degree(self.sharding)
+        batch_axis = 1 if self.superbatch > 1 else 0
 
         def _make(x):
             x = np.asarray(x)
             sharding = self.sharding
-            if x.ndim == 0 or (x.shape[0] * num_processes) % data_degree != 0:
+            if (
+                x.ndim <= batch_axis
+                or (x.shape[batch_axis] * num_processes) % data_degree != 0
+            ):
                 # batch not divisible over the data axes: replicate (correct,
                 # just not parallel) rather than crash mid-epoch.
                 logger.warning_once(
                     "batch dim %s not divisible by data-parallel degree %s; "
                     "replicating this input",
-                    x.shape[0] if x.ndim else 0,
+                    x.shape[batch_axis] if x.ndim > batch_axis else 0,
                     data_degree,
                 )
                 sharding = jax.sharding.NamedSharding(
                     self.sharding.mesh, jax.sharding.PartitionSpec()
                 )
+                return jax.device_put(x, sharding)
+            if batch_axis == 1:
+                sharding = self._stacked_sharding()
+                if num_processes > 1:
+                    global_shape = (
+                        x.shape[0],
+                        x.shape[1] * num_processes,
+                    ) + x.shape[2:]
+                    try:
+                        return jax.make_array_from_process_local_data(
+                            sharding, x, global_shape
+                        )
+                    except TypeError:  # older jax: no global_shape arg
+                        return jax.make_array_from_process_local_data(sharding, x)
                 return jax.device_put(x, sharding)
             if num_processes > 1:
                 return jax.make_array_from_process_local_data(sharding, x)
@@ -415,6 +498,10 @@ class DataLoaderShard(DataLoaderStateMixin):
         cancelled = threading.Event()
         try:
             source = self._factory()
+            if self.superbatch > 1:
+                # the generator is consumed by the producer thread, so the
+                # K-way stacking (host collate) happens off the step loop
+                source = _stack_superbatches(source, self.superbatch)
 
             def _put(item) -> bool:
                 """put that gives up when the consumer is gone (break/GC) —
@@ -465,8 +552,9 @@ class DataLoaderShard(DataLoaderStateMixin):
                 if self.global_batch_size == 0:
                     # iterable-of-batches path: learn the batch size from the
                     # first batch so the tail's remainder is detected
-                    self.global_batch_size = valid
-                gbs = self.global_batch_size
+                    self.global_batch_size = valid // self.superbatch
+                # a full superbatch carries K microbatches' worth of samples
+                gbs = self.global_batch_size * self.superbatch
                 if nxt is stop:
                     # one-batch lookahead: mark last batch before yielding it
                     # (reference data_loader.py:445-476)
@@ -500,6 +588,10 @@ class DataLoaderDispatcher(DataLoaderShard):
         try:
             is_main = jax.process_index() == 0
             source = self._factory() if is_main else None
+            if source is not None and self.superbatch > 1:
+                # stack before broadcast so every process receives the
+                # ready-made [K, micro, ...] superbatch
+                source = _stack_superbatches(source, self.superbatch)
             skipped = 0
 
             def _next_payload():
@@ -537,7 +629,11 @@ class DataLoaderDispatcher(DataLoaderShard):
                 idx = jax.process_index()
 
                 def _slice(x):
-                    local = x.shape[0] // num
+                    # superbatch payloads carry the batch dim at axis 1
+                    axis = 1 if self.superbatch > 1 and x.ndim > 1 else 0
+                    local = x.shape[axis] // num
+                    if axis == 1:
+                        return x[:, idx * local : (idx + 1) * local]
                     return x[idx * local : (idx + 1) * local]
 
                 local_batch = recursively_apply(
@@ -552,9 +648,8 @@ class DataLoaderDispatcher(DataLoaderShard):
                 batch, valid = _to_batch(current)
                 if nxt[2]:
                     self.end_of_dataloader = True
-                    self.remainder = (
-                        valid if valid != self.global_batch_size else 0
-                    )
+                    full = self.global_batch_size * self.superbatch
+                    self.remainder = valid if valid != full else 0
                 yield batch
                 current = nxt
         finally:
@@ -568,6 +663,7 @@ def prepare_data_loader(
     config: Optional[DataLoaderConfiguration] = None,
     seed: int = 0,
     skip_batches: int = 0,
+    superbatch: int = 1,
 ) -> DataLoaderShard:
     """Turn a host dataloader into a DataLoaderShard (reference
     data_loader.py:797 decision tree).
@@ -583,6 +679,12 @@ def prepare_data_loader(
     loader yields the global batch (``batch_size * num_processes``) as one
     sharded array (``split_batches=True``: the incoming batch is already the
     global batch and is split).
+
+    ``superbatch=K`` (K > 1) puts the loader in stacked mode for fused
+    gradient accumulation: each yielded device batch stacks K consecutive
+    microbatches as ``[K, micro, ...]`` (K axis replicated, batch axis
+    data-sharded); a partial final group is padded by repeating its last
+    microbatch with the true sample count recorded in ``remainder``.
     """
     state = state or AcceleratorState()
     config = config or getattr(state, "dataloader_config", None) or DataLoaderConfiguration()
@@ -657,6 +759,7 @@ def prepare_data_loader(
             global_bs,
             prefetch_size=config.prefetch_size,
             sampler=sampler,
+            superbatch=superbatch,
             _skip_batches=skip_batches,
         )
         # exposed for join_uneven_inputs: flipping .even_batches takes
@@ -681,6 +784,7 @@ def prepare_data_loader(
         sharding,
         global_batch_size=getattr(dataloader, "global_batch_size", 0) or 0,
         prefetch_size=config.prefetch_size,
+        superbatch=superbatch,
         _skip_batches=skip_batches,
     )
 

@@ -410,6 +410,19 @@ class GradientState:
         return self.plugin_kwargs.get("sync_each_batch", False)
 
     @property
+    def fused(self) -> bool:
+        """Whether accumulation runs fused: one compiled step per optimizer
+        step, scanning over a stacked ``[num_steps, micro, ...]`` batch.
+
+        Falls back to the ``ACCELERATE_TPU_FUSED_ACCUM`` env flag: the
+        plugin's ``to_kwargs`` keeps only non-default fields, and with the
+        env set a default-constructed plugin ALSO has fused=True, so the
+        knob would otherwise vanish from ``plugin_kwargs``."""
+        if "fused" in self.plugin_kwargs:
+            return self.plugin_kwargs["fused"]
+        return parse_flag_from_env(ENV_PREFIX + "FUSED_ACCUM")
+
+    @property
     def end_of_dataloader(self) -> bool:
         return (
             self.active_dataloader is not None

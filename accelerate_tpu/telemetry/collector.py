@@ -189,6 +189,7 @@ class StepTelemetry:
         retraced: bool = False,
         label: str = "step",
         compile_stats: Optional[dict] = None,
+        extra: Optional[dict] = None,
     ) -> Optional[dict]:
         """Complete one step: block on ``result`` (the async boundary),
         build the record, emit to sinks, beat the heartbeat. Returns the
@@ -197,7 +198,13 @@ class StepTelemetry:
         ``compile_stats`` (from ``CompileMonitor.delta``) attributes any
         compile cost this step paid: XLA compile seconds and
         persistent-cache hit/miss counts land on the step record, so a
-        first-step (or retrace) latency spike is explained in place."""
+        first-step (or retrace) latency spike is explained in place.
+
+        ``extra`` merges host-known fields straight onto the record — the
+        step wrappers use it for the perf shape of the step function
+        (``microbatches``, ``dispatches_per_opt_step``) so fused
+        accumulation's 1-dispatch-per-optimizer-step win is visible in
+        every sink."""
         if not self.enabled:
             return None
         total_s, dispatch_s = self._timer.stop(result)
@@ -227,6 +234,10 @@ class StepTelemetry:
                 record["compile_time_saved_s"] = float(
                     compile_stats["compile_time_saved_s"]
                 )
+
+        if extra:
+            for key, value in extra.items():
+                record.setdefault(key, value)
 
         tokens = None
         if batch is not None:
@@ -266,7 +277,17 @@ class StepTelemetry:
         if self.config.include_step_metrics and metrics is not None:
             # the step already crossed the blocking boundary, so these 0-d
             # reads are free (no extra sync)
-            for key, value in _scalar_items(metrics):
+            scalars = dict(_scalar_items(metrics))
+            # non-sync microbatch steps carry no gradient norm — the step
+            # reports NaN there (never a fake 0.0) and we omit the field
+            # entirely so tracker charts only see real sync-step norms
+            # (NaN is also invalid JSON for the JSONL sink)
+            gnorm = scalars.get("grad_norm")
+            if gnorm is not None and (
+                not np.isfinite(gnorm) or not scalars.get("is_sync_step", 1.0)
+            ):
+                del scalars["grad_norm"]
+            for key, value in scalars.items():
                 record.setdefault(key, value)
 
         self._emitted += 1

@@ -30,7 +30,15 @@ JSONL record schema (one object per line; ``kind`` discriminates):
     host_rss_bytes     int    current process RSS
     retraced           bool   this call (re)compiled (first compile included)
     recompiles         int    cumulative retraces beyond first compiles
-    loss/grad_norm/... float  0-d numeric step metrics (include_step_metrics)
+    microbatches       int    microbatches this record covers (fused
+                              accumulation: K; unfused / no accum: 1)
+    dispatches_per_opt_step
+                       int    jit dispatches one optimizer step costs
+                              (fused: 1; unfused with accumulation: K)
+    loss/grad_norm/... float  0-d numeric step metrics (include_step_metrics).
+                              grad_norm appears ONLY on sync steps with a
+                              finite norm — non-sync microbatch records omit
+                              it (never a fake 0.0)
 
 Steps that paid compile cost additionally carry (from ``CompileMonitor``):
 

@@ -189,12 +189,19 @@ class DistributedInitKwargs(KwargsHandler):
 class GradientAccumulationPlugin(KwargsHandler):
     """Reference utils/dataclasses.py:654. On TPU, accumulation happens
     *inside* the compiled step via a carried grad buffer, so `sync_gradients`
-    is a traced predicate rather than a Python flag."""
+    is a traced predicate rather than a Python flag.
+
+    ``fused=True`` (env: ``ACCELERATE_TPU_FUSED_ACCUM``) selects the fused
+    execution mode: one compiled step per OPTIMIZER step that takes a
+    stacked ``[num_steps, micro_batch, ...]`` batch and runs the microbatch
+    loop under ``lax.scan`` — one dispatch per optimizer step instead of
+    ``num_steps``, no carried accumulation buffer in HBM between calls."""
 
     num_steps: int = 1
     adjust_scheduler: bool = True
     sync_with_dataloader: bool = True
     sync_each_batch: bool = False
+    fused: bool = False
 
     def __post_init__(self):
         env = os.environ.get(ENV_PREFIX + "GRADIENT_ACCUMULATION_STEPS")
@@ -202,6 +209,16 @@ class GradientAccumulationPlugin(KwargsHandler):
             self.num_steps = int(env)
         if self.num_steps < 1:
             raise ValueError("num_steps must be >= 1")
+        if not self.fused:
+            from .environment import parse_flag_from_env
+
+            self.fused = parse_flag_from_env(ENV_PREFIX + "FUSED_ACCUM")
+        if self.fused and self.sync_each_batch:
+            raise ValueError(
+                "fused accumulation folds every microbatch into one optimizer "
+                "step; sync_each_batch=True contradicts that — use the "
+                "unfused path for per-microbatch sync"
+            )
 
 
 @dataclass

@@ -66,6 +66,7 @@ def _configs(on_tpu: bool):
                 4, 128, 3, 1,
             ),
             "ckpt": (TransformerConfig.tiny(), 4, 64, 8, 2),
+            "accum": (TransformerConfig.tiny(), 4, 64, 6, 2),
         }
     dense = TransformerConfig(
         # ~916M params (Llama-8B width, depth cut to fit one 16G v5e chip
@@ -197,6 +198,19 @@ def _configs(on_tpu: bool):
                 longseq, max_seq_len=4096, attention_impl="xla",
                 remat="full",
             ), 1, 4096, 8, 2, "sgd",
+        ),
+        # gradient accumulation at K=8: fused lax.scan (1 dispatch/opt
+        # step) vs unfused per-microbatch lax.cond (K dispatches). Modest
+        # width — the metric is per-opt-step wall time and dispatch count,
+        # not MFU, so it only needs enough compute that dispatch overhead
+        # is visible next to it.
+        "accum": (
+            TransformerConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_layers=2, num_heads=16, num_kv_heads=8,
+                max_seq_len=512, dtype="bfloat16",
+            ),
+            4, 512, 8, 2,
         ),
         "decode": (decode, 1, 128, 64, 1),  # B, prompt_len, new_tokens, reps
         # checkpoint-open -> device-resident for the decode model; its own
@@ -417,6 +431,92 @@ def _run_ckpt(cfg, batch_size: int, seq: int, iters: int, warmup: int):
     }
 
 
+def _run_accum(cfg, batch_size: int, seq: int, iters: int, warmup: int,
+               accum_steps: int = 8):
+    """Per-OPTIMIZER-step cost of gradient accumulation at K=accum_steps:
+    the fused ``lax.scan`` path (one dispatch per optimizer step over a
+    stacked ``[K, B, S]`` batch) vs the unfused per-microbatch
+    ``lax.cond`` path (K dispatches). Both modes run the same model for
+    the same number of optimizer steps; ``dispatches_per_opt_step`` is
+    read back from the telemetry step records (the field exists so this
+    win is visible in production sinks, not just here). ``vs_baseline``
+    is unfused/fused per-opt-step wall time: >= 1 means fused wins.
+    """
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import CausalLM, count_params
+    from accelerate_tpu.utils.dataclasses import GradientAccumulationPlugin
+
+    K = accum_steps
+    out: dict[str, dict] = {}
+    n_params = 0
+    for mode in ("unfused", "fused"):
+        fused = mode == "fused"
+        _reset_state()
+        model = CausalLM(cfg)
+        acc = Accelerator(
+            mixed_precision="bf16",
+            gradient_accumulation_plugin=GradientAccumulationPlugin(
+                num_steps=K, fused=fused
+            ),
+            telemetry=True,
+        )
+        params = acc.prepare(
+            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))[
+                "params"
+            ]
+        )
+        n_params = count_params(params)
+        opt = acc.prepare(optax.adamw(3e-4))
+        carry = acc.init_carry(params, opt)
+        step = acc.unified_step(CausalLM.loss_fn(model), max_grad_norm=1.0)
+        ids = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch_size, seq)
+        ).astype(np.int32)
+        micro = {"input_ids": jnp.asarray(ids)}
+        batch = (
+            {"input_ids": jnp.asarray(np.stack([ids] * K))} if fused else micro
+        )
+        calls_per_opt_step = 1 if fused else K
+        for _ in range(warmup * calls_per_opt_step):
+            carry, metrics = step(carry, batch)
+        np.asarray(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters * calls_per_opt_step):
+            carry, metrics = step(carry, batch)
+        np.asarray(metrics["loss"])
+        dt = time.perf_counter() - t0
+        recs = [
+            r for r in acc.telemetry.records if r.get("kind") == "step"
+        ]
+        out[mode] = {
+            "opt_step_s": dt / iters,
+            "dispatches_per_opt_step": recs[-1]["dispatches_per_opt_step"],
+            "microbatches_per_record": recs[-1]["microbatches"],
+            "opt_steps_timed": iters,
+        }
+
+    fused_s = out["fused"]["opt_step_s"]
+    unfused_s = out["unfused"]["opt_step_s"]
+    return {
+        "metric": "accum_fused_opt_step_seconds",
+        "value": round(fused_s, 4),
+        "unit": "s",
+        "vs_baseline": round(unfused_s / fused_s, 3) if fused_s > 0 else None,
+        "extra": {
+            "accum_steps": K,
+            "fused": {k: round(v, 4) if isinstance(v, float) else v
+                      for k, v in out["fused"].items()},
+            "unfused": {k: round(v, 4) if isinstance(v, float) else v
+                        for k, v in out["unfused"].items()},
+            "params": n_params,
+            "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
+            "batch": batch_size, "seq": seq,
+        },
+    }
+
+
 def _run_decode(cfg, batch_size: int, prompt_len: int, new_tokens: int,
                 reps: int):
     """Autoregressive generation benchmark -> (s/token, n_params, load_s).
@@ -615,6 +715,10 @@ def _result_line(name, cfg, batch_size, seq, iters, warmup,
         return rec
     if name == "ckpt":
         rec = _run_ckpt(cfg, batch_size, seq, iters, warmup)
+        rec["extra"].update(probe())
+        return rec
+    if name == "accum":
+        rec = _run_accum(cfg, batch_size, seq, iters, warmup)
         rec["extra"].update(probe())
         return rec
     if name == "decode":

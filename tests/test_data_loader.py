@@ -172,3 +172,69 @@ def test_prepare_rejects_indivisible_batch():
     loader = DataLoader(RangeDataset(16), batch_size=4, shuffle=False)
     with pytest.raises(ValueError, match="divisible by the data-parallel"):
         prepare_data_loader(loader, state)
+
+
+# --------------------------------------------------------------------- #
+# superbatch mode (fused gradient accumulation's stacked input contract)
+# --------------------------------------------------------------------- #
+def test_superbatch_loader_stacks_microbatches():
+    state = AcceleratorState()
+    loader = DataLoader(RangeDataset(32), batch_size=8, shuffle=False)
+    prepared = prepare_data_loader(loader, state, superbatch=2)
+    assert prepared.superbatch == 2
+    assert len(prepared) == 2  # 4 microbatches stacked in pairs
+    batches = list(prepared)
+    assert len(batches) == 2
+    # stacked [K, micro, ...]; K axis replicated, batch axis keeps dp
+    assert batches[0]["x"].shape == (2, 8, 2)
+    assert batches[0]["y"].shape == (2, 8)
+    spec = batches[0]["x"].sharding.spec
+    assert spec[0] is None
+    assert spec[1] in ("dp", ("dp",))
+    # slot k is exactly the k-th consecutive microbatch
+    np.testing.assert_array_equal(
+        np.asarray(batches[0]["y"]), np.arange(16).reshape(2, 8)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(batches[1]["y"]), np.arange(16, 32).reshape(2, 8)
+    )
+    assert prepared.remainder == 0
+
+
+def test_superbatch_batch_spec_matches_batches():
+    """batch_spec() must report the STACKED shape (the AOT warmup and
+    retrace-detector contract for the fused step)."""
+    state = AcceleratorState()
+    loader = DataLoader(RangeDataset(32), batch_size=8, shuffle=False)
+    prepared = prepare_data_loader(loader, state, superbatch=2)
+    spec = prepared.batch_spec()
+    batch = next(iter(prepared))
+    got = jax.tree.map(lambda s: (s.shape, jnp.dtype(s.dtype)), spec)
+    want = jax.tree.map(lambda a: (a.shape, jnp.dtype(a.dtype)), batch)
+    assert got == want
+    assert spec["x"].sharding == batch["x"].sharding
+
+
+def test_superbatch_partial_final_batch_padded():
+    """24 samples / (gbs=8 x K=2): the final superbatch holds ONE real
+    microbatch — padded by repeating it (static shape) with the true
+    sample count threaded through as the remainder for loss masking."""
+    state = AcceleratorState()
+    gs = GradientState()
+    loader = DataLoader(RangeDataset(24), batch_size=8, shuffle=False)
+    prepared = prepare_data_loader(loader, state, superbatch=2)
+    assert len(prepared) == 2  # ceil(3 microbatches / 2)
+    seen = []
+    for batch in prepared:
+        assert batch["y"].shape == (2, 8)  # shape stays static
+        seen.append((np.asarray(batch["y"]), gs.end_of_dataloader, gs.remainder))
+    first, last = seen[0], seen[-1]
+    np.testing.assert_array_equal(first[0], np.arange(16).reshape(2, 8))
+    assert first[1] is False and first[2] == -1
+    # pad slot repeats the last real microbatch; remainder = 8 real samples
+    np.testing.assert_array_equal(last[0][0], np.arange(16, 24))
+    np.testing.assert_array_equal(last[0][1], np.arange(16, 24))
+    assert last[1] is True
+    assert last[2] == 8
+    # spec still matches the padded static shape
+    assert prepared.batch_spec()["y"].shape == (2, 8)

@@ -1,0 +1,307 @@
+"""Fused gradient accumulation: the `lax.scan`-over-stacked-microbatches
+step (one dispatch per OPTIMIZER step) must be arithmetically identical to
+the per-microbatch `lax.cond` path, compose with the superbatch dataloader
+and AOT warmup (zero retraces), and fix the metric semantics (no fake
+grad_norm=0.0 on non-sync steps) on BOTH paths."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, DataLoader
+from accelerate_tpu.state import AcceleratorState, GradientState
+from accelerate_tpu.telemetry import TelemetryConfig
+from accelerate_tpu.telemetry.sinks import TrackerBridgeSink
+from accelerate_tpu.utils.dataclasses import GradientAccumulationPlugin
+
+
+class RegressionDataset:
+    def __init__(self, n=96, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(n, 1)).astype(np.float32)
+        self.y = (2.0 * self.x[:, 0] + 3.0 + 0.05 * rng.normal(size=n)).astype(
+            np.float32
+        )
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def loss_fn(params, batch):
+    pred = batch["x"][:, 0] * params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _reset():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+
+
+def _run_mode(
+    fused,
+    *,
+    K=4,
+    n=96,
+    batch_size=8,
+    mixed_precision=None,
+    policy=None,
+    max_grad_norm=None,
+    w0=0.0,
+    remat_policy=None,
+    optimizer=None,
+    telemetry=False,
+):
+    """One full pass over the dataset in one accumulation mode; returns
+    (final carry, accelerator, last metrics)."""
+    _reset()
+    kwargs = {}
+    if mixed_precision is not None:
+        kwargs["mixed_precision"] = mixed_precision
+    if policy is not None:
+        kwargs["mixed_precision_policy"] = policy
+    acc = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=K, fused=fused
+        ),
+        telemetry=telemetry,
+        **kwargs,
+    )
+    ds = RegressionDataset(n)
+    loader = DataLoader(ds, batch_size=batch_size, shuffle=False)
+    params = {"w": jnp.asarray(w0), "b": jnp.asarray(0.0)}
+    params, opt, prepared = acc.prepare(
+        params, optimizer or optax.adam(0.1), loader
+    )
+    step = acc.unified_step(
+        loss_fn, opt, max_grad_norm=max_grad_norm, remat_policy=remat_policy
+    )
+    carry = acc.init_carry(params, opt)
+    metrics = None
+    for batch in prepared:
+        carry, metrics = step(carry, batch)
+    return carry, acc, metrics
+
+
+def _tree_bitwise_equal(a, b):
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    for la, lb in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_fused_parity_fp32_bitwise():
+    """ISSUE 4 acceptance: fused and unfused bitwise-match params (and
+    opt_state) after 3 optimizer steps in fp32."""
+    unfused, acc_u, _ = _run_mode(False)
+    fused, acc_f, _ = _run_mode(True)
+    assert int(unfused["opt_step"]) == int(fused["opt_step"]) == 3
+    _tree_bitwise_equal(unfused["params"], fused["params"])
+    _tree_bitwise_equal(unfused["opt_state"], fused["opt_state"])
+    # carry layout: the fused mode dropped the per-call bookkeeping
+    assert "micro_step" in unfused and "accum_grads" in unfused
+    assert "micro_step" not in fused and "accum_grads" not in fused
+    # host-mirror recovery works on both carry layouts
+    acc_f.sync_from_carry(fused)
+    assert acc_f.step == 3 and acc_f.gradient_state.sync_gradients
+    acc_u.sync_from_carry(unfused)
+    assert acc_u.step == 12
+
+
+def test_fused_parity_with_clipping():
+    unfused, _, mu = _run_mode(False, max_grad_norm=0.5, w0=50.0)
+    fused, _, mf = _run_mode(True, max_grad_norm=0.5, w0=50.0)
+    _tree_bitwise_equal(unfused["params"], fused["params"])
+    # the sync-step gradient norm is the same real (pre-clip) norm
+    assert float(mu["grad_norm"]) == float(mf["grad_norm"]) > 0.5
+
+
+def test_fused_parity_bf16_compute():
+    unfused, _, _ = _run_mode(False, mixed_precision="bf16")
+    fused, _, _ = _run_mode(True, mixed_precision="bf16")
+    for key in ("w", "b"):
+        np.testing.assert_allclose(
+            float(unfused["params"][key]),
+            float(fused["params"][key]),
+            rtol=2e-2,
+        )
+    # master params stay fp32 in both modes
+    assert fused["params"]["w"].dtype == jnp.float32
+
+
+def test_fused_fp16_overflow_skip_parity():
+    """fp16 loss-scaling overflow: a huge w makes the scaled backward
+    overflow fp16, so BOTH paths must skip the update (params held), halve
+    the scale, and still advance opt_step — identically."""
+    from accelerate_tpu import MixedPrecisionPolicy
+
+    def make_policy():
+        policy = MixedPrecisionPolicy.from_precision("fp16")
+        policy.loss_scale_init = 2.0**15
+        return policy
+
+    results = {}
+    for fused in (False, True):
+        carry, _, metrics = _run_mode(
+            fused, policy=make_policy(), mixed_precision="fp16", w0=1e4,
+            optimizer=optax.sgd(1e-4),
+        )
+        assert not bool(metrics["grads_finite"])  # the overflow was real
+        results[fused] = carry
+    unfused, fused = results[False], results[True]
+    assert int(unfused["opt_step"]) == int(fused["opt_step"]) == 3
+    _tree_bitwise_equal(unfused["params"], fused["params"])
+    # every step overflowed: params held at init, scale halved per step
+    assert float(fused["params"]["w"]) == 1e4
+    assert float(unfused["loss_scale"].scale) == float(
+        fused["loss_scale"].scale
+    ) == 2.0**15 / 2**3
+
+
+def test_fused_remat_policy_parity():
+    plain, _, _ = _run_mode(True)
+    remat, _, _ = _run_mode(True, remat_policy=True)
+    np.testing.assert_allclose(
+        float(plain["params"]["w"]), float(remat["params"]["w"]), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(plain["params"]["b"]), float(remat["params"]["b"]), rtol=1e-6
+    )
+
+
+def test_fused_zero_retraces_after_warmup():
+    """ISSUE 4 acceptance: the fused path compiles exactly one executable
+    per optimizer step — after AOT warmup from the superbatch loader's
+    spec, no real call traces; telemetry shows one record per optimizer
+    step with microbatches=K and dispatches_per_opt_step=1."""
+    _reset()
+    K = 4
+    acc = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=K, fused=True
+        ),
+        telemetry=True,
+    )
+    ds = RegressionDataset(64)
+    loader = DataLoader(ds, batch_size=8, shuffle=False)
+    params = {"w": jnp.asarray(0.0), "b": jnp.asarray(0.0)}
+    params, opt, prepared = acc.prepare(params, optax.adam(0.1), loader)
+    assert prepared.superbatch == K  # auto-wired from the fused plugin
+    step = acc.unified_step(loss_fn, opt)
+    carry = acc.init_carry(params, opt)
+
+    record = acc.warmup(step, carry, prepared)
+    assert record["compile_time_s"] > 0
+    detector = acc.telemetry.detector(step.label)
+    signatures = len(detector._seen)
+
+    opt_steps = 0
+    for batch in prepared:
+        carry, metrics = step(carry, batch)
+        opt_steps += 1
+    assert opt_steps == 2  # 8 microbatches / K — every call IS an opt step
+    assert int(carry["opt_step"]) == opt_steps
+    assert detector.retraces == 0
+    assert len(detector._seen) == signatures  # true AOT dispatch
+
+    recs = [r for r in acc.telemetry.records if r.get("kind") == "step"]
+    assert len(recs) == opt_steps
+    for rec in recs:
+        assert rec["retraced"] is False
+        assert rec["microbatches"] == K
+        assert rec["dispatches_per_opt_step"] == 1
+        assert rec["is_sync_step"] == 1.0
+        assert np.isfinite(rec["grad_norm"])
+
+
+def test_trackers_never_see_fake_grad_norm():
+    """Satellite: non-sync microbatch steps must not report grad_norm=0.0.
+    The unfused path's hold branch reports NaN and the collector OMITS the
+    field, so JSONL records and tracker charts only ever see real
+    sync-step norms."""
+
+    class CaptureTracker:
+        def __init__(self):
+            self.logged = []
+
+        def log(self, values, step=None):
+            self.logged.append(values)
+
+    _reset()
+    K = 2
+    acc = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=K),
+        telemetry=TelemetryConfig(),
+    )
+    tracker = CaptureTracker()
+    acc.telemetry.add_sink(TrackerBridgeSink([tracker]))
+    ds = RegressionDataset(64)
+    loader = DataLoader(ds, batch_size=8, shuffle=False)
+    params = {"w": jnp.asarray(0.0), "b": jnp.asarray(0.0)}
+    params, opt, prepared = acc.prepare(params, optax.adam(0.1), loader)
+    step = acc.unified_step(loss_fn, opt)
+    carry = acc.init_carry(params, opt)
+    for batch in prepared:
+        carry, _ = step(carry, batch)
+
+    recs = [r for r in acc.telemetry.records if r.get("kind") == "step"]
+    sync = [r for r in recs if r["is_sync_step"] == 1.0]
+    nonsync = [r for r in recs if r["is_sync_step"] != 1.0]
+    assert len(sync) == 4 and len(nonsync) == 4
+    for rec in nonsync:
+        assert "grad_norm" not in rec  # omitted, not NaN and never 0.0
+        assert "loss" in rec  # per-microbatch loss still reported
+    for rec in sync:
+        assert np.isfinite(rec["grad_norm"]) and rec["grad_norm"] > 0.0
+    # trackers: a grad_norm of exactly 0.0 never reaches a chart
+    logged_norms = [
+        v["telemetry/grad_norm"]
+        for v in tracker.logged
+        if "telemetry/grad_norm" in v
+    ]
+    assert len(logged_norms) == len(sync)
+    assert all(n > 0.0 for n in logged_norms)
+    assert tracker.logged  # the bridge did forward the other fields
+
+
+def test_fused_step_rejects_unfused_carry():
+    _reset()
+    acc = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=2, fused=True
+        )
+    )
+    params = {"w": jnp.asarray(0.0)}
+
+    def l(p, b):
+        return jnp.mean((b["x"][:, 0] * p["w"]) ** 2)
+
+    params = acc.prepare(params)
+    opt = acc.prepare(optax.sgd(0.1))
+    step = acc.unified_step(l, opt)
+    stale = acc.init_carry(params, opt, fused_accumulation=False)
+    batch = {"x": jnp.ones((2, 8, 1))}
+    with pytest.raises(ValueError, match="fused accumulation carries no"):
+        step(stale, batch)
+
+
+def test_fused_env_knob(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_FUSED_ACCUM", "1")
+    plugin = GradientAccumulationPlugin(num_steps=4)
+    assert plugin.fused
+    _reset()
+    acc = Accelerator(gradient_accumulation_steps=4)
+    assert acc.gradient_state.fused
+    params = acc.prepare({"w": jnp.asarray(0.0)})
+    opt = acc.prepare(optax.sgd(0.1))
+    carry = acc.init_carry(params, opt)
+    assert "micro_step" not in carry and "accum_grads" not in carry
+
+
+def test_fused_rejects_sync_each_batch():
+    with pytest.raises(ValueError, match="sync_each_batch"):
+        GradientAccumulationPlugin(num_steps=2, fused=True, sync_each_batch=True)
