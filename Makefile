@@ -28,9 +28,11 @@ FAST_FILES = \
   tests/test_data_loader.py tests/test_checkpointing.py \
   tests/test_ring_attention.py tests/test_seq2seq.py \
   tests/test_telemetry.py tests/test_compilation.py \
-  tests/test_checkpoint_async.py tests/test_fused_accum.py
+  tests/test_checkpoint_async.py tests/test_fused_accum.py \
+  tests/test_diagnostics.py
 
-.PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke
+.PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
+  diag-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -69,3 +71,13 @@ accum-smoke:
 	  tests/test_fused_accum.py::test_fused_parity_fp32_bitwise \
 	  tests/test_fused_accum.py::test_fused_zero_retraces_after_warmup
 	python bench.py accum
+
+# diagnostics end-to-end on CPU: a tiny train loop with an injected slow
+# step and an injected NaN gradient runs with the flight recorder on,
+# anomalies fire (rate-limited), the run dumps, and `accelerate-tpu
+# diagnose` turns the directory into a report. The SIGKILL survivability
+# test rides along (slow-marked, so it runs here but not in tier 1).
+diag-smoke:
+	$(PYTEST) -q \
+	  tests/test_diagnostics.py::test_accelerator_diagnostics_end_to_end \
+	  tests/test_diagnostics.py::test_sigkilled_run_leaves_dump_diagnose_names_it

@@ -21,6 +21,17 @@ from .big_modeling import (
 )
 from .checkpoint_async import AsyncCheckpointer, save_accelerator_state_async
 from .data_loader import DataLoader, prepare_data_loader, skip_first_batches
+from .diagnostics import (
+    AnomalyDetector,
+    DiagnosticsConfig,
+    DiagnosticsManager,
+    FlightRecorder,
+    GoodputAccounting,
+    TraceCapture,
+    build_report,
+    format_report,
+    list_dumps,
+)
 from .fault_tolerance import CheckpointManager
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import LocalSGD
@@ -94,4 +105,13 @@ __all__ = [
     "JSONLSink",
     "PrometheusTextSink",
     "TrackerBridgeSink",
+    "DiagnosticsConfig",
+    "DiagnosticsManager",
+    "GoodputAccounting",
+    "AnomalyDetector",
+    "TraceCapture",
+    "FlightRecorder",
+    "list_dumps",
+    "build_report",
+    "format_report",
 ]

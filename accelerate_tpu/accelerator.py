@@ -28,6 +28,7 @@ semantically identical, and still correct on TPU).
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import os
 from contextlib import contextmanager
@@ -106,6 +107,7 @@ class Accelerator:
         mixed_precision_policy: Optional[MixedPrecisionPolicy] = None,
         profile_kwargs=None,
         telemetry: Optional[Union[bool, TelemetryConfig]] = None,
+        diagnostics=None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(
             project_dir=project_dir
@@ -164,7 +166,19 @@ class Accelerator:
         # unified_step hooks (async-aware timing, retrace detection,
         # heartbeat, sinks); None/False leaves a disabled handle whose
         # hooks are no-ops — no per-step block_until_ready, no threads.
+        # `diagnostics` (True / dump-dir path / DiagnosticsConfig) layers
+        # goodput accounting, anomaly detection, triggered trace capture
+        # and the flight recorder on top — and implies telemetry on.
+        if diagnostics is not None and diagnostics is not False:
+            if telemetry is None or telemetry is False or telemetry is True:
+                telemetry = TelemetryConfig(diagnostics=diagnostics)
+            elif telemetry.diagnostics is None:
+                telemetry = dataclasses.replace(telemetry, diagnostics=diagnostics)
         self.telemetry = StepTelemetry(telemetry)
+        if self.telemetry.diagnostics is not None:
+            # triggered captures honor the same ProfileKwargs tracer
+            # options as accelerator.profile()
+            self.telemetry.diagnostics.set_profile_kwargs(self.profile_handler)
         self._built_steps = 0  # names the retrace detector per built step fn
 
     # ------------------------------------------------------------------ #

@@ -7,6 +7,7 @@ import argparse
 import sys
 
 from .config import config_command_parser
+from .diagnose import diagnose_command_parser
 from .env import env_command_parser
 from .estimate import estimate_command_parser
 from .launch import launch_command_parser
@@ -23,6 +24,7 @@ def main(argv=None) -> None:
     )
     subparsers = parser.add_subparsers(dest="command")
     config_command_parser(subparsers)
+    diagnose_command_parser(subparsers)
     launch_command_parser(subparsers)
     env_command_parser(subparsers)
     estimate_command_parser(subparsers)

@@ -361,7 +361,7 @@ class DataLoaderShard(DataLoaderStateMixin):
             return q.get()
         t0 = time.perf_counter()
         item = q.get()
-        tel.record_dataloader_wait(time.perf_counter() - t0)
+        tel.record_dataloader_wait(time.perf_counter() - t0, source="shard")
         return item
 
     @property
@@ -620,7 +620,9 @@ class DataLoaderDispatcher(DataLoaderShard):
                     return _next_payload()
                 t0 = time.perf_counter()
                 payload = _next_payload()
-                tel.record_dataloader_wait(time.perf_counter() - t0)
+                tel.record_dataloader_wait(
+                    time.perf_counter() - t0, source="dispatcher"
+                )
                 return payload
 
             def _to_batch(payload):

@@ -1,0 +1,221 @@
+"""Post-mortem aggregation: turn per-host flight-recorder dumps and
+heartbeat files into one report.
+
+``accelerate-tpu diagnose <dir>`` answers the three questions an
+operator asks after a multi-host job dies or hangs:
+
+* **who stopped first** — merge heartbeat staleness with each dump's
+  ``last_step``: among the stale ranks, the one with the *lowest* last
+  completed step stopped first (everyone else stalled behind it at the
+  next collective);
+* **where can I restart from** — the newest checkpoint any rank saw
+  committed, cross-checked against the on-disk ``COMMITTED`` marker when
+  the directory is reachable;
+* **where did the time go** — the fleet badput breakdown summed from
+  each dump's goodput snapshot, plus anomaly/exception counts.
+
+Pure functions over files — nothing here imports jax or touches the
+accelerator, so the CLI works on a dead job's artifacts from any
+machine that can read the directory.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from ..telemetry.heartbeat import scan_heartbeats
+from .flight_recorder import list_dumps
+from .goodput import BADPUT_BUCKETS, BUCKETS
+
+
+def _checkpoint_status(path: Optional[str]) -> Optional[bool]:
+    """True/False when the checkpoint dir is reachable, None when not
+    (diagnose often runs off-cluster against copied dump dirs)."""
+    if not path or not os.path.isdir(path):
+        return None
+    try:
+        from ..checkpoint_async.commit import is_committed
+
+        return bool(is_committed(path))
+    except Exception:
+        return None
+
+
+def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
+    """Aggregate ``dir``'s flight-recorder dumps + heartbeat files."""
+    dumps = list_dumps(dir)
+    heartbeats = scan_heartbeats(dir, stall_timeout_s=stall_timeout_s)
+
+    ranks: dict[int, dict[str, Any]] = {}
+    for rank in sorted(set(dumps) | set(heartbeats)):
+        dump = dumps.get(rank)
+        hb = heartbeats.get(rank)
+        info: dict[str, Any] = {"rank": rank}
+        if dump is not None:
+            info.update(
+                last_step=dump.get("last_step"),
+                dump_reason=dump.get("reason"),
+                dump_time_unix=dump.get("time_unix"),
+                dump_count=dump.get("dumps"),
+            )
+        if hb is not None:
+            info.update(
+                heartbeat_age_s=hb.get("age_s"),
+                stale=hb.get("stale"),
+                stalled_self=hb.get("stalled"),
+            )
+            if info.get("last_step") is None:
+                info["last_step"] = hb.get("step")
+        ranks[rank] = info
+
+    # --- who stopped first --------------------------------------------- #
+    stale = [r for r in ranks.values() if r.get("stale")]
+    candidates = stale or (list(ranks.values()) if heartbeats == {} else [])
+    straggler = None
+    if candidates:
+        with_step = [r for r in candidates if r.get("last_step") is not None]
+        if with_step:
+            steps = {r["last_step"] for r in with_step}
+            # a uniform last_step across a dump-only report is a clean
+            # shutdown, not a straggler
+            if stale or len(steps) > 1:
+                straggler = min(with_step, key=lambda r: r["last_step"])
+        elif stale:
+            straggler = stale[0]
+
+    # --- where can I restart from -------------------------------------- #
+    checkpoints = [
+        d["last_checkpoint"] for d in dumps.values() if d.get("last_checkpoint")
+    ]
+    last_checkpoint = None
+    if checkpoints:
+        last_checkpoint = max(
+            checkpoints,
+            key=lambda c: (c.get("step") or -1, c.get("time_unix") or 0.0),
+        )
+        last_checkpoint = dict(last_checkpoint)
+        last_checkpoint["committed"] = _checkpoint_status(last_checkpoint.get("dir"))
+
+    # --- where did the time go ----------------------------------------- #
+    goodput_pcts = []
+    badput: dict[str, float] = {b: 0.0 for b in BUCKETS}
+    for dump in dumps.values():
+        snap = dump.get("goodput")
+        if not snap:
+            continue
+        if snap.get("goodput_pct") is not None:
+            goodput_pcts.append(snap["goodput_pct"])
+        for bucket, seconds in (snap.get("buckets") or {}).items():
+            if bucket in badput:
+                badput[bucket] += float(seconds)
+
+    anomalies: dict[str, int] = {}
+    exceptions: list[dict] = []
+    stalls = 0
+    for rank, dump in dumps.items():
+        for ev in dump.get("events", []):
+            kind = ev.get("event")
+            if kind == "anomaly":
+                t = ev.get("anomaly_type", "unknown")
+                anomalies[t] = anomalies.get(t, 0) + 1
+            elif kind == "exception":
+                exceptions.append({"rank": rank, **ev})
+            elif kind == "heartbeat_stall":
+                stalls += 1
+
+    return {
+        "dir": dir,
+        "num_ranks": len(ranks),
+        "num_dumps": len(dumps),
+        "num_heartbeats": len(heartbeats),
+        "ranks": {r: ranks[r] for r in sorted(ranks)},
+        "straggler": straggler,
+        "last_checkpoint": last_checkpoint,
+        "goodput_pct": (
+            sum(goodput_pcts) / len(goodput_pcts) if goodput_pcts else None
+        ),
+        "badput_s": badput,
+        "anomalies": anomalies,
+        "heartbeat_stalls": stalls,
+        "exceptions": exceptions,
+    }
+
+
+def format_report(report: dict) -> str:
+    """Human-readable rendering of :func:`build_report`'s output."""
+    lines = [
+        f"accelerate-tpu diagnose: {report['dir']}",
+        f"  ranks seen: {report['num_ranks']} "
+        f"({report['num_dumps']} flight dump(s), "
+        f"{report['num_heartbeats']} heartbeat(s))",
+        "",
+    ]
+
+    straggler = report.get("straggler")
+    if straggler is not None:
+        age = straggler.get("heartbeat_age_s")
+        lines.append(
+            f"STRAGGLER: rank {straggler['rank']} stopped first "
+            f"(last step {straggler.get('last_step')}"
+            + (f", heartbeat silent {age:.0f}s" if age is not None else "")
+            + ")"
+        )
+    elif any(r.get("stale") for r in report["ranks"].values()):
+        lines.append("STALLED: stale ranks found but none could be ordered")
+    else:
+        lines.append("No straggler: all ranks current or shut down cleanly.")
+
+    ckpt = report.get("last_checkpoint")
+    if ckpt is not None:
+        status = {True: "committed", False: "NOT COMMITTED", None: "unverified"}[
+            ckpt.get("committed")
+        ]
+        lines.append(
+            f"Last checkpoint: step {ckpt.get('step')} at {ckpt.get('dir')} "
+            f"[{status}]"
+        )
+    else:
+        lines.append("Last checkpoint: none recorded")
+
+    gp = report.get("goodput_pct")
+    lines.append("")
+    lines.append(
+        "Goodput: " + (f"{gp:.1f}% productive" if gp is not None else "no data")
+    )
+    badput = report.get("badput_s") or {}
+    total_bad = sum(badput.get(b, 0.0) for b in BADPUT_BUCKETS)
+    if total_bad > 0:
+        lines.append("Badput breakdown (fleet seconds):")
+        for bucket in BADPUT_BUCKETS:
+            seconds = badput.get(bucket, 0.0)
+            pct = 100.0 * seconds / total_bad
+            lines.append(f"  {bucket:<11} {seconds:10.1f}s  ({pct:4.1f}% of badput)")
+
+    anomalies = report.get("anomalies") or {}
+    if anomalies:
+        parts = ", ".join(f"{t}={n}" for t, n in sorted(anomalies.items()))
+        lines.append(f"Anomalies: {parts}")
+    if report.get("heartbeat_stalls"):
+        lines.append(f"Heartbeat stalls recorded: {report['heartbeat_stalls']}")
+    for exc in report.get("exceptions", []):
+        lines.append(
+            f"Exception on rank {exc['rank']}: {exc.get('exception', '?')}"
+        )
+
+    lines.append("")
+    per_rank_header = f"  {'rank':>4}  {'last_step':>9}  {'dump_reason':<22} state"
+    lines.append("Per-rank detail:")
+    lines.append(per_rank_header)
+    for rank, info in report["ranks"].items():
+        if info.get("stale"):
+            state = "STALE"
+        elif info.get("heartbeat_age_s") is not None:
+            state = "alive"
+        else:
+            state = "dump-only"
+        lines.append(
+            f"  {rank:>4}  {str(info.get('last_step')):>9}  "
+            f"{str(info.get('dump_reason')):<22} {state}"
+        )
+    return "\n".join(lines)

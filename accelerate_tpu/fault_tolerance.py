@@ -169,6 +169,11 @@ class CheckpointManager:
             out = self.accelerator.save_state(carry=carry)
             self._stopped = True
             logger.warning(f"preemption checkpoint written to {out}")
+            diagnostics = getattr(self.accelerator.telemetry, "diagnostics", None)
+            if diagnostics is not None:
+                # the final flight dump records the committed checkpoint,
+                # so `diagnose` on the dead job names the restart point
+                diagnostics.dump("preemption")
             return out
         if self.async_saves:
             from .checkpoint_async import save_accelerator_state_async

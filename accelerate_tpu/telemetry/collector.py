@@ -81,6 +81,7 @@ class StepTelemetry:
         self.sinks: list[TelemetrySink] = []
         self.records: collections.deque = collections.deque(maxlen=config.history)
         self.heartbeat: Optional[HeartbeatMonitor] = None
+        self.diagnostics = None
         self._detectors: dict[str, RecompileDetector] = {}
         self._timer = AsyncStepTimer()
         self._dl_wait = 0.0
@@ -93,11 +94,20 @@ class StepTelemetry:
         self._emit_lock = threading.Lock()
         if config.enabled and config.jsonl_path is not None:
             self.add_sink(JSONLSink(config.jsonl_path))
+        if config.enabled and config.diagnostics is not None:
+            from ..diagnostics.manager import DiagnosticsManager
+
+            self.diagnostics = DiagnosticsManager(config.diagnostics)
         if config.enabled and config.heartbeat:
             self.heartbeat = HeartbeatMonitor(
                 dir=config.heartbeat_dir,
                 interval_s=config.heartbeat_interval_s,
                 stall_timeout_s=config.heartbeat_stall_timeout_s,
+                on_stall=(
+                    self.diagnostics.on_stall
+                    if self.diagnostics is not None
+                    else None
+                ),
             ).start()
 
     # ------------------------------------------------------------------ #
@@ -117,15 +127,32 @@ class StepTelemetry:
                 self._is_emitting_rank = True
         return self._is_emitting_rank
 
-    def _emit(self, record: dict) -> None:
+    def _emit(self, record: dict, scalars: Optional[dict] = None) -> None:
+        """Emit one record: ring, sinks, then diagnostics.
+
+        ``scalars`` is the raw 0-d metric dict of a step BEFORE the
+        non-finite ``grad_norm`` filtering below — NaN detection needs
+        the values the record can't carry (NaN is invalid JSON).
+        Diagnostics-derived records (anomaly/goodput) re-enter here once;
+        the manager archives them without deriving further.
+        """
         self.records.append(record)
-        if not self.sinks or not self._should_emit():
-            return
-        with self._emit_lock:
-            if not self._meta_written:
-                self._meta_written = True
-                self._emit_raw(self._meta_record())
-            self._emit_raw(record)
+        if self.sinks and self._should_emit():
+            with self._emit_lock:
+                if not self._meta_written:
+                    self._meta_written = True
+                    self._emit_raw(self._meta_record())
+                self._emit_raw(record)
+        if self.diagnostics is not None:
+            try:
+                derived = self.diagnostics.observe(record, scalars)
+            except Exception as exc:
+                self._sink_errors += 1
+                if self._sink_errors <= 3:
+                    logger.warning(f"telemetry diagnostics failed: {exc}")
+                derived = []
+            for extra_record in derived:
+                self._emit(extra_record)
 
     def _emit_raw(self, record: dict) -> None:
         for sink in self.sinks:
@@ -168,11 +195,20 @@ class StepTelemetry:
             det = self._detectors[name] = RecompileDetector(name)
         return det
 
-    def record_dataloader_wait(self, seconds: float) -> None:
+    def record_dataloader_wait(
+        self, seconds: float, source: str = "dataloader"
+    ) -> None:
         """Accumulate host time spent blocked waiting for a batch; drained
-        into the next step record. Called by the prepared dataloader."""
-        if self.enabled:
-            self._dl_wait += seconds
+        into the next step record. Called by the prepared dataloader.
+        ``source`` names which loader path blocked (``"shard"`` /
+        ``"dispatcher"``) for diagnostics stall events."""
+        if not self.enabled:
+            return
+        self._dl_wait += seconds
+        if self.diagnostics is not None:
+            # live attribution: a starved loop with no subsequent step
+            # still shows up in the goodput dataloader bucket
+            self.diagnostics.record_wait(seconds, source=source)
 
     def begin_step(self) -> None:
         """Mark the host-side start of a step call."""
@@ -274,10 +310,13 @@ class StepTelemetry:
             record["hbm_bytes_limit"] = stats["bytes_limit"]
             record["host_rss_bytes"] = host_memory_rss()
 
-        if self.config.include_step_metrics and metrics is not None:
+        raw_scalars = None
+        if metrics is not None:
             # the step already crossed the blocking boundary, so these 0-d
             # reads are free (no extra sync)
-            scalars = dict(_scalar_items(metrics))
+            raw_scalars = dict(_scalar_items(metrics))
+        if self.config.include_step_metrics and raw_scalars is not None:
+            scalars = dict(raw_scalars)
             # non-sync microbatch steps carry no gradient norm — the step
             # reports NaN there (never a fake 0.0) and we omit the field
             # entirely so tracker charts only see real sync-step norms
@@ -291,7 +330,7 @@ class StepTelemetry:
                 record.setdefault(key, value)
 
         self._emitted += 1
-        self._emit(record)
+        self._emit(record, raw_scalars)
         if self.heartbeat is not None:
             self.heartbeat.beat(step)
         return record
@@ -412,12 +451,26 @@ class StepTelemetry:
             )
         if self.heartbeat is not None:
             out["stalls"] = self.heartbeat.stalls
+        if self.diagnostics is not None:
+            diag = self.diagnostics.summary()
+            goodput = diag.get("goodput")
+            if goodput is not None:
+                # promote the headline numbers; the breakdown stays nested
+                out["goodput_pct"] = goodput["goodput_pct"]
+                out["rolling_goodput_pct"] = goodput["rolling_goodput_pct"]
+            out.update(diag)
         return out
 
     def close(self) -> None:
-        """Stop the watchdog and close every sink (idempotent)."""
+        """Stop the watchdog, final-dump diagnostics, and close every
+        sink (idempotent)."""
         if self.heartbeat is not None:
             self.heartbeat.stop()
+        if self.diagnostics is not None:
+            try:
+                self.diagnostics.close()
+            except Exception as exc:
+                logger.warning(f"telemetry diagnostics close failed: {exc}")
         for sink in self.sinks:
             try:
                 sink.close()

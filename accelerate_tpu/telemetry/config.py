@@ -9,7 +9,10 @@ zero-overhead disabled handle (no per-step host sync, no threads).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Callable, Optional
+from typing import TYPE_CHECKING, Any, Callable, Optional, Union
+
+if TYPE_CHECKING:  # import cycle: diagnostics reads telemetry records
+    from ..diagnostics.config import DiagnosticsConfig
 
 
 @dataclass
@@ -53,6 +56,16 @@ class TelemetryConfig:
 
     ``all_ranks``: emit records to sinks on every process instead of the
     main process only (sinks must use per-rank paths).
+
+    ``diagnostics``: attach the interpretation layer
+    (:class:`~accelerate_tpu.diagnostics.DiagnosticsManager`): goodput
+    accounting, anomaly detection, anomaly-triggered trace capture and
+    the per-process flight recorder. Pass ``True`` for defaults, a path
+    string as shorthand for ``DiagnosticsConfig(dir=path)``, or a full
+    :class:`~accelerate_tpu.diagnostics.DiagnosticsConfig`. When the
+    diagnostics dir is set and no ``heartbeat_dir`` was given, the
+    heartbeat files land in the same dir — ``accelerate-tpu diagnose``
+    wants both in one place.
     """
 
     enabled: bool = True
@@ -68,12 +81,35 @@ class TelemetryConfig:
     heartbeat_interval_s: float = 10.0
     heartbeat_stall_timeout_s: float = 300.0
     all_ranks: bool = False
+    diagnostics: Optional[Union[bool, str, "DiagnosticsConfig"]] = None
 
     def __post_init__(self):
         if self.memory_interval < 0:
             raise ValueError("memory_interval must be >= 0")
         if self.history < 1:
             raise ValueError("history must be >= 1")
+        if self.diagnostics is not None:
+            # lazy import: diagnostics.diagnose reads telemetry heartbeats,
+            # so a module-level import here would be a cycle
+            from ..diagnostics.config import DiagnosticsConfig
+
+            if self.diagnostics is False:
+                self.diagnostics = None
+            elif self.diagnostics is True:
+                self.diagnostics = DiagnosticsConfig()
+            elif isinstance(self.diagnostics, str):
+                self.diagnostics = DiagnosticsConfig(dir=self.diagnostics)
+            elif not isinstance(self.diagnostics, DiagnosticsConfig):
+                raise TypeError(
+                    "diagnostics must be bool, a dump-dir path, or a "
+                    f"DiagnosticsConfig; got {type(self.diagnostics).__name__}"
+                )
+        if (
+            self.diagnostics is not None
+            and self.diagnostics.dir is not None
+            and self.heartbeat_dir is None
+        ):
+            self.heartbeat_dir = self.diagnostics.dir
         if self.heartbeat_dir is not None:
             # a dir implies the watchdog: writing rank files without the
             # monitor thread would leave them permanently stale

@@ -72,6 +72,36 @@ background writer thread, after the commit rename)::
     write_bandwidth_bytes_per_s float? bytes / IO seconds (background_s
                                        for async, blocked_s for sync)
 
+``kind="goodput"`` (every ``goodput_interval`` steps when diagnostics is
+on; the wall-clock attribution fold)::
+
+    step                 int?   step at emission
+    wall_s               float  run wall-clock so far
+    goodput_pct          float? productive / wall * 100 (run so far)
+    rolling_goodput_pct  float? same over the last goodput_window_s
+    productive_s         float  step execution minus in-step compile
+    badput_compile_s     float  in-step retraces + AOT warmups
+    badput_dataloader_s  float  host blocked waiting for batches
+    badput_checkpoint_s  float  train-loop blocked seconds of saves
+                                (async background time is NOT badput)
+    badput_idle_s        float  unaccounted remainder (setup, eval,
+                                recovery); buckets sum to wall_s
+
+``kind="anomaly"`` (rate-limited: at most one per type per
+``anomaly_cooldown_steps`` / ``anomaly_cooldown_s``)::
+
+    anomaly_type           str    "slow_step" | "loss_spike" | "nan_grad"
+    step                   int?   offending step
+    value                  float  offending value (step seconds / loss /
+                                  the non-finite scalar)
+    baseline_median        float  rolling median at detection (baselined
+    baseline_mad           float  types only)
+    suppressed_since_last  int    rate-limited repeats since the previous
+                                  emitted record of this type
+    total_of_type          int    cumulative count including suppressed
+    record                 dict   the offending step's FULL record — the
+                                  evidence travels with the alarm
+
 Fields marked ``?`` are null when not derivable; memory fields are absent
 on steps skipped by ``memory_interval``.
 """
@@ -119,6 +149,13 @@ class JSONLSink(TelemetrySink):
 
     def close(self) -> None:
         if not self._file.closed:
+            # fsync before close: the JSONL is frequently the only record
+            # of a run that is about to be SIGKILLed by its scheduler
+            self._file.flush()
+            try:
+                os.fsync(self._file.fileno())
+            except OSError:
+                pass  # not every target supports fsync (pipes, some FUSE)
             self._file.close()
 
 
@@ -148,7 +185,7 @@ class PrometheusTextSink(TelemetrySink):
         self._gauges: dict[tuple[str, str], float] = {}  # (metric, label) -> value
 
     def emit(self, record: dict) -> None:
-        if record.get("kind") not in (None, "step"):
+        if record.get("kind") not in (None, "step", "goodput"):
             return
         label = str(record.get("label", "step"))
         for key, value in record.items():
@@ -160,13 +197,22 @@ class PrometheusTextSink(TelemetrySink):
             self._gauges[(f"{self.prefix}_{name}", label)] = float(value)
         self._write()
 
+    @staticmethod
+    def _escape_label(value: str) -> str:
+        # Prometheus text exposition: \, " and newline must be escaped
+        # inside quoted label values or the scrape breaks
+        return (
+            value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+        )
+
     def _write(self) -> None:
         lines = []
         for metric in sorted({m for m, _ in self._gauges}):
             lines.append(f"# TYPE {metric} gauge")
             for (m, label), value in sorted(self._gauges.items()):
                 if m == metric:
-                    lines.append(f'{metric}{{label="{label}"}} {value}')
+                    escaped = self._escape_label(label)
+                    lines.append(f'{metric}{{label="{escaped}"}} {value}')
         tmp = f"{self.path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write("\n".join(lines) + "\n")
@@ -196,7 +242,7 @@ class TrackerBridgeSink(TelemetrySink):
         return src
 
     def emit(self, record: dict) -> None:
-        if record.get("kind") not in (None, "step"):
+        if record.get("kind") not in (None, "step", "goodput"):
             return
         values = {
             f"{self.prefix}{k}": v

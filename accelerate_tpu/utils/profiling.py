@@ -68,43 +68,56 @@ class PeakHostMemory:
     bracket abandoned without ``stop()`` (exception between start_measure
     and end_measure) exits its thread as soon as the tracker is GC'd,
     instead of busy-polling a core for the process lifetime. The 1 ms
-    sleep bounds the poll at ~1 kHz — still far denser than real RSS
-    transients — and gives the GC a chance to run.
+    poll quantum bounds sampling at ~1 kHz — still far denser than real
+    RSS transients — and gives the GC a chance to run.
+
+    ``stop()`` is deterministic: the per-bracket stop :class:`~threading.
+    Event` wakes the thread out of its wait immediately and the join has
+    no timeout, so when ``stop()`` returns the thread is GONE — repeated
+    ``start()``/``stop()`` cycles on one tracker never stack daemon
+    threads.
     """
 
     def __init__(self):
-        self._monitoring = False
+        self._stop_event = threading.Event()
         self._peak = -1
         self._thread: Optional[threading.Thread] = None
 
     @staticmethod
-    def _monitor(ref: "weakref.ref[PeakHostMemory]"):
-        while True:
+    def _monitor(ref: "weakref.ref[PeakHostMemory]", stop_event: threading.Event):
+        # the event is passed by value: a GC'd tracker still unblocks the
+        # loop via the dead weakref, and a live tracker's stop() wakes the
+        # wait without the 1 ms worst-case latency of a sleep
+        while not stop_event.is_set():
             self = ref()
-            if self is None or not self._monitoring:
+            if self is None:
                 break
             self._peak = max(self._peak, host_memory_rss())
             del self  # don't pin the tracker between samples
-            time.sleep(0.001)
+            stop_event.wait(0.001)
 
     def start(self):
-        if self._monitoring:
+        if self._thread is not None and self._thread.is_alive():
             raise RuntimeError(
                 "PeakHostMemory.start() while already monitoring; use one "
                 "tracker per measurement bracket"
             )
-        self._monitoring = True
+        self._stop_event = threading.Event()  # fresh per bracket
         self._peak = host_memory_rss()
         self._thread = threading.Thread(
-            target=PeakHostMemory._monitor, args=(weakref.ref(self),),
+            target=PeakHostMemory._monitor,
+            args=(weakref.ref(self), self._stop_event),
             daemon=True,
         )
         self._thread.start()
 
     def stop(self) -> int:
-        self._monitoring = False
+        """Stop and JOIN the monitor thread; returns the observed peak.
+        Idempotent — extra calls just return the last peak."""
+        self._stop_event.set()
         if self._thread is not None:
             self._thread.join()
+            self._thread = None
         return self._peak
 
 
