@@ -29,10 +29,10 @@ FAST_FILES = \
   tests/test_ring_attention.py tests/test_seq2seq.py \
   tests/test_telemetry.py tests/test_compilation.py \
   tests/test_checkpoint_async.py tests/test_fused_accum.py \
-  tests/test_diagnostics.py
+  tests/test_diagnostics.py tests/test_benchmarks.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
-  diag-smoke
+  diag-smoke bench-fast-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -71,6 +71,17 @@ accum-smoke:
 	  tests/test_fused_accum.py::test_fused_parity_fp32_bitwise \
 	  tests/test_fused_accum.py::test_fused_zero_retraces_after_warmup
 	python bench.py accum
+
+# deadline-aware bench end-to-end on CPU: `bench.py --fast --deadline
+# 120` must exit 0 within the window with a complete stream (every fast
+# variant accounted for — final, partial, or explicit skip — and the
+# parseable dense headline on the last line); the SIGKILL partial-
+# recovery test rides along (both slow-marked, so they run here but not
+# in tier 1)
+bench-fast-smoke:
+	$(PYTEST) -q \
+	  tests/test_benchmarks.py::test_bench_fast_deadline_end_to_end \
+	  tests/test_benchmarks.py::test_sigkilled_child_leaves_recoverable_partial
 
 # diagnostics end-to-end on CPU: a tiny train loop with an injected slow
 # step and an injected NaN gradient runs with the flight recorder on,

@@ -1,0 +1,40 @@
+"""Deadline-aware benchmark subsystem.
+
+The former monolithic ``bench.py`` split along its real seams:
+
+* :mod:`.registry` — what can run, priorities, process groups, costs
+* :mod:`.scheduler` — deadline, persisted estimates, budget allocation
+* :mod:`.measure` — the per-variant measurement bodies
+* :mod:`.partial` — fsync'd partial-result streaming child -> parent
+* :mod:`.runner` — group launching, retries, folding, the output stream
+* :mod:`.cli` — ``python bench.py`` / ``python -m accelerate_tpu.benchmarks``
+"""
+
+from .partial import PartialWriter, partial_path, partial_record, read_partial
+from .registry import Variant, VariantRegistry, build_registry
+from .runner import BenchRunner, LaunchResult, SubprocessLauncher
+from .scheduler import (
+    Deadline,
+    DeadlineScheduler,
+    Estimates,
+    Planned,
+    skip_record,
+)
+
+__all__ = [
+    "BenchRunner",
+    "Deadline",
+    "DeadlineScheduler",
+    "Estimates",
+    "LaunchResult",
+    "PartialWriter",
+    "Planned",
+    "SubprocessLauncher",
+    "Variant",
+    "VariantRegistry",
+    "build_registry",
+    "partial_path",
+    "partial_record",
+    "read_partial",
+    "skip_record",
+]

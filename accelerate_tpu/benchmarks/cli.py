@@ -1,0 +1,222 @@
+"""Bench entry point (``python bench.py`` / ``python -m
+accelerate_tpu.benchmarks``).
+
+Three modes:
+
+* **parent** (default): detect the backend in a subprocess (never
+  initialize an exclusively-locked TPU in the parent), build the
+  registry, plan against the deadline, launch one child per process
+  group through :class:`~.runner.BenchRunner`.
+* **child** (``--child A B ... --budget S --partial-dir D``): run the
+  listed members in-process under a self-enforced budget, stream
+  fsync'd partial snapshots, print one JSON line per member. Explicit
+  buffer teardown (``gc.collect`` + ``jax.clear_caches``) between
+  members keeps a shared child from carrying one config's HBM into the
+  next.
+* **direct** (``python bench.py accum``): bare variant names with no
+  ``--deadline`` run in-process and print their lines — the historical
+  single-variant interface (Makefile smokes use it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Optional
+
+from .partial import ENV_PARTIAL_DIR, PartialWriter, partial_path
+from .registry import build_registry
+from .runner import BenchRunner, SubprocessLauncher
+from .scheduler import (
+    ENV_DEADLINE,
+    Deadline,
+    DeadlineScheduler,
+    Estimates,
+    skip_record,
+)
+
+
+def _detect_backend() -> str:
+    """Backend without initializing it in THIS process: on hosts where
+    the TPU is an exclusively-locked local device, a parent that touches
+    it would starve the per-variant child processes."""
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=300,
+        )
+        return probe.stdout.strip().splitlines()[-1]
+    except Exception:  # noqa: BLE001 — fall back to in-process detection
+        import jax
+
+        return jax.default_backend()
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="bench", description="accelerate_tpu benchmark harness",
+    )
+    p.add_argument("variants", nargs="*",
+                   help="variant names to run (default: the full matrix)")
+    p.add_argument("--fast", action="store_true",
+                   help="CI subset: the CPU-safe fast-flagged variants")
+    p.add_argument("--deadline", type=float, default=None,
+                   help=f"global wall-clock budget in seconds "
+                        f"(env {ENV_DEADLINE})")
+    p.add_argument("--list", action="store_true",
+                   help="print the registry (names, priorities, groups)")
+    p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--budget", type=float, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--partial-dir", default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def _run_child(names: list[str], budget_s: Optional[float],
+               partial_dir: Optional[str]) -> int:
+    """Run ``names`` in THIS process under a self-enforced budget.
+
+    The parent's subprocess timeout is the hard kill; the child's own
+    Deadline only lets it skip later members it can already see won't
+    fit — an explicit ``{"skipped": "budget"}`` line beats dying
+    mid-compile."""
+    import gc
+
+    import jax
+
+    from accelerate_tpu.compilation import activate_persistent_cache
+    from accelerate_tpu.utils.dataclasses import CompilePlugin
+
+    from .measure import result_line
+
+    # join the cache dir the parent exported (covers the decode/
+    # generation variants too, which never build an Accelerator — the
+    # training path would also pick the env var up through CompilePlugin)
+    activate_persistent_cache(CompilePlugin())  # no-op when env unset
+    on_tpu = jax.default_backend() == "tpu"
+    registry = build_registry(on_tpu)
+    estimates = Estimates().load()
+    deadline = Deadline(budget_s)
+    rc = 0
+    for i, name in enumerate(names):
+        variant = registry.get(name)
+        est = estimates.estimate(name, variant.default_estimate_s)
+        if i > 0 and not deadline.fits(est):
+            # later group member that can't fit the leftover budget:
+            # skip explicitly rather than get SIGKILLed mid-compile
+            print(json.dumps(skip_record(
+                name, est, deadline.remaining(), reason="budget",
+            )), flush=True)
+            continue
+        writer = PartialWriter(
+            partial_path(partial_dir, name) if partial_dir else None, name,
+        )
+        try:
+            rec = result_line(variant, partial=writer)
+        except Exception as exc:  # noqa: BLE001 — isolate group members
+            print(f"variant {name} failed: {exc!r}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        else:
+            print(json.dumps({"variant": name, **rec}), flush=True)
+        finally:
+            if i < len(names) - 1:
+                # explicit buffer teardown between group members: drop
+                # python refs, then the jit executable + donated-buffer
+                # caches, so the next config starts with a clean device
+                gc.collect()
+                jax.clear_caches()
+                gc.collect()
+    return rc
+
+
+def _run_direct(names: list[str]) -> int:
+    """Historical interface: run the named variants in-process and print
+    their lines (``python bench.py accum``)."""
+    from accelerate_tpu.compilation import activate_persistent_cache
+    from accelerate_tpu.utils.dataclasses import CompilePlugin
+
+    from .measure import result_line
+
+    import jax
+
+    activate_persistent_cache(CompilePlugin())
+    registry = build_registry(jax.default_backend() == "tpu")
+    partial_dir = os.environ.get(ENV_PARTIAL_DIR)
+    for name in names:
+        variant = registry.get(name)
+        writer = PartialWriter(
+            partial_path(partial_dir, name) if partial_dir else None, name,
+        )
+        rec = result_line(variant, partial=writer)
+        print(json.dumps({"variant": name, **rec}), flush=True)
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.child:
+        return _run_child(args.variants, args.budget, args.partial_dir)
+
+    on_tpu = _detect_backend() == "tpu"
+    registry = build_registry(on_tpu)
+    try:
+        registry = registry.select(
+            names=args.variants or None, fast=args.fast,
+        )
+    except KeyError as exc:
+        print(exc.args[0], file=sys.stderr)
+        return 2
+
+    if args.list:
+        for name in registry.names:
+            v = registry.get(name)
+            print(json.dumps({
+                "variant": v.name, "kind": v.kind, "priority": v.priority,
+                "group": v.group, "fast": v.fast, "headline": v.headline,
+                "default_estimate_s": v.default_estimate_s,
+            }))
+        return 0
+
+    if args.variants and args.deadline is None and not args.fast:
+        # bare names, no scheduling flags: the historical in-process path
+        return _run_direct(args.variants)
+
+    # One persistent XLA cache dir shared by every variant child (they
+    # inherit the env; CompilePlugin reads it). The variants share model
+    # shapes across retries and the longseq/longseq4k pairs, so repeated
+    # programs deserialize instead of recompiling — the rc=124 driver
+    # timeouts that erased BENCH_r05 were mostly serial compile time.
+    # Children run SERIALLY, so sharing is safe (concurrent writers to
+    # one cache dir deadlocked in a past parallel-pytest measurement —
+    # do not copy this pattern into parallel workers).
+    os.environ.setdefault(
+        "ACCELERATE_TPU_COMPILE_CACHE",
+        os.path.join(tempfile.gettempdir(),
+                     "accelerate_tpu_bench_xla_cache"),
+    )
+
+    deadline = Deadline.from_env(args.deadline)
+    estimates = Estimates().load()
+    scheduler = DeadlineScheduler(
+        deadline,
+        # CPU CI variants finish in seconds; a 60s floor would let one
+        # group starve the plan on a 120s deadline
+        min_budget_s=60.0 if on_tpu else 30.0,
+    )
+    partial_dir = tempfile.mkdtemp(prefix="accelerate_tpu_bench_partial_")
+    runner = BenchRunner(
+        registry, scheduler, estimates,
+        SubprocessLauncher(partial_dir),
+        partial_dir=partial_dir,
+        settle_s=60.0 if on_tpu else 5.0,
+        on_tpu=on_tpu,
+    )
+    return runner.run()

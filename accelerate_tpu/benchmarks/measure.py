@@ -1,0 +1,848 @@
+"""Per-variant measurement bodies.
+
+Each ``_run_*`` measures one variant kind and :func:`result_line` wraps
+it into the emitted JSON record ``{"metric", "value", "unit",
+"vs_baseline", "extra"}``. For training lines ``vs_baseline`` = achieved
+MFU / 0.60 (BASELINE.md north-star >= 60% MFU); for the decode line it
+is 0.05 / (s/token), the speedup over the reference's GPT-J-6B number;
+>= 1.0 means "meets/beats the reference target" in both cases.
+
+Measured loops stream progress through a :class:`~.partial.PartialWriter`
+(fsync'd after warmup and every N measured iters) so a budget-killed
+child still yields a usable ``{"partial": true}`` number — precision
+lost, measurement kept. The loops therefore sync at CHUNK boundaries
+(``writer.chunk(iters)`` iters apart) instead of once at the end; the
+chunk sync costs one pipeline drain per quarter-loop, noise next to a
+step, and is what makes a partial value honest.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .partial import PartialWriter
+
+# bf16 peak FLOPs per chip by device kind (public cloud specs)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "cpu": 1e12,  # nominal, so vs_baseline stays defined on CPU test runs
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for name, flops in PEAK_FLOPS.items():
+        if name.lower() in str(kind).lower():
+            return flops
+    return 197e12 if device.platform == "tpu" else 1e12
+
+
+def _reset_state():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _device_kind() -> str:
+    return str(getattr(jax.devices()[0], "device_kind", "cpu"))
+
+
+def _noop_writer(name: str) -> PartialWriter:
+    return PartialWriter(None, name)
+
+
+def _mfu(cfg, n_params: int, seq: int, tokens_per_sec_chip: float) -> float:
+    # Honest model-FLOP accounting (remat recompute NOT counted — standard
+    # MFU convention):
+    #   * 6N counts only matmul-active params: the untied input embedding
+    #     is a gather in forward (no MXU work), so it is excluded; lm_head
+    #     is a real matmul and stays in (tied embeddings would count once).
+    #   * attention: QK^T + PV are 4*S*(nh*hd) fwd flops/token/layer, 3x
+    #     for fwd+bwd = 12*S*(nh*hd), halved for causal masking (the flash
+    #     kernel really skips the masked blocks) -> 6*S*nh*hd per layer.
+    matmul_params = n_params
+    if not cfg.tie_embeddings:
+        matmul_params -= cfg.vocab_size * cfg.hidden_size
+    if cfg.num_experts > 0:
+        # sparse MoE: each token computes only K of E experts — count the
+        # ACTIVE expert params (capacity-padding overhead is real runtime
+        # but not useful FLOPs, so it correctly depresses MFU)
+        expert_params = (
+            cfg.num_experts * 3 * cfg.hidden_size * cfg.intermediate_size
+            * cfg.num_layers
+        )
+        matmul_params -= expert_params
+        matmul_params += (
+            expert_params * cfg.num_experts_per_tok // cfg.num_experts
+        )
+    attn_flops_per_token = 6 * seq * cfg.num_heads * cfg.head_dim * cfg.num_layers
+    flops_per_token = 6 * matmul_params + attn_flops_per_token
+    return tokens_per_sec_chip * flops_per_token / _peak_flops(jax.devices()[0])
+
+
+def _run(cfg, batch_size: int, seq: int, iters: int, warmup: int,
+         optimizer: str = "adamw", partial: Optional[PartialWriter] = None):
+    """Train-step throughput for one config -> (tokens/s/chip, step_s, n_params)."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import CausalLM, count_params
+
+    partial = partial or _noop_writer("train")
+    _reset_state()
+    model = CausalLM(cfg)
+    acc = Accelerator(mixed_precision="bf16")
+    params = acc.prepare(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))["params"]
+    )
+    n_params = count_params(params)
+    opt = acc.prepare(
+        optax.adamw(3e-4) if optimizer == "adamw" else optax.sgd(3e-4)
+    )
+    carry = acc.init_carry(params, opt)
+    step = acc.unified_step(CausalLM.loss_fn(model), max_grad_norm=1.0)
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch_size, seq)),
+        jnp.int32,
+    )
+    batch = {"input_ids": ids}
+
+    # sync by fetching a scalar that depends on the whole step chain
+    # (axon quirk: block_until_ready is unreliable/slow through the tunnel)
+    for _ in range(warmup):
+        carry, metrics = step(carry, batch)
+    np.asarray(metrics["loss"])
+    partial.update(phase="warmup_done", iters_measured=0)
+
+    chunk = partial.chunk(iters)
+    tokens_per_step = batch_size * seq / jax.device_count()
+    measured = 0
+    t0 = time.perf_counter()
+    while measured < iters:
+        n = min(chunk, iters - measured)
+        for _ in range(n):
+            carry, metrics = step(carry, batch)
+        np.asarray(metrics["loss"])  # chunk boundary: honest partial value
+        measured += n
+        dt = time.perf_counter() - t0
+        partial.update(
+            phase="measuring", iters_measured=measured,
+            metric="train_tokens_per_sec_per_chip",
+            value=round(tokens_per_step * measured / dt, 1),
+            unit="tokens/s/chip",
+            extra={"step_time_s": round(dt / measured, 4),
+                   "params": n_params, "device": _device_kind(),
+                   "batch": batch_size, "seq": seq},
+        )
+
+    step_time = dt / iters
+    tokens_per_sec_chip = tokens_per_step / step_time
+    return tokens_per_sec_chip, step_time, n_params
+
+
+def _run_ckpt(cfg, batch_size: int, seq: int, iters: int, warmup: int,
+              partial: Optional[PartialWriter] = None):
+    """Step-time perturbation of cadence checkpoints: sync vs async saves.
+
+    Runs the SAME train loop twice (fresh state each time), saving every
+    few steps through CheckpointManager — once synchronously, once through
+    the async subsystem — and reports the train-loop-blocked seconds per
+    save (the ``kind="checkpoint"`` telemetry field) plus the step-time
+    spike a save adds on top of a quiet step. ``vs_baseline`` is
+    sync_blocked / async_blocked: >= 1 means async hides the IO.
+    """
+    import shutil
+    import tempfile
+
+    import optax
+
+    from accelerate_tpu import Accelerator, CheckpointManager, ProjectConfiguration
+    from accelerate_tpu.models import CausalLM, count_params
+
+    partial = partial or _noop_writer("ckpt")
+    every_n = max(2, iters // 4)
+    out: dict[str, dict] = {}
+    n_params = 0
+    for mode in ("sync", "async"):
+        _reset_state()
+        project_dir = tempfile.mkdtemp(prefix=f"bench_ckpt_{mode}_")
+        try:
+            model = CausalLM(cfg)
+            acc = Accelerator(
+                mixed_precision="bf16",
+                project_config=ProjectConfiguration(
+                    project_dir=project_dir,
+                    automatic_checkpoint_naming=True,
+                    total_limit=2,
+                ),
+                telemetry=True,
+            )
+            params = acc.prepare(
+                model.init(
+                    jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+                )["params"]
+            )
+            n_params = count_params(params)
+            opt = acc.prepare(optax.adamw(3e-4))
+            carry = acc.init_carry(params, opt)
+            step = acc.unified_step(CausalLM.loss_fn(model))
+            ids = jnp.asarray(
+                np.random.default_rng(0).integers(
+                    0, cfg.vocab_size, (batch_size, seq)
+                ),
+                jnp.int32,
+            )
+            batch = {"input_ids": ids}
+            for _ in range(warmup):
+                carry, metrics = step(carry, batch)
+            np.asarray(metrics["loss"])
+            partial.update(phase=f"{mode}_warmup_done", iters_measured=0)
+
+            mgr = CheckpointManager(
+                acc, every_n_steps=every_n, handle_signals=False,
+                async_saves=(mode == "async"),
+            )
+            save_steps, quiet_steps = [], []
+            for i in range(1, iters + 1):
+                t0 = time.perf_counter()
+                carry, metrics = step(carry, batch)
+                np.asarray(metrics["loss"])  # step fully done before the save
+                saved = mgr.step(carry)
+                dt = time.perf_counter() - t0
+                (save_steps if saved else quiet_steps).append(dt)
+            mgr.wait()
+            mgr.close()
+            recs = [
+                r for r in acc.telemetry.records
+                if r.get("kind") == "checkpoint"
+            ]
+            out[mode] = {
+                "saves": len(recs),
+                "blocked_s": float(np.mean([r["blocked_s"] for r in recs])),
+                "background_s": float(
+                    np.mean([r["background_s"] for r in recs])
+                ),
+                "bytes_written": int(recs[-1]["bytes_written"]),
+                "write_bandwidth_gib_s": round(
+                    float(
+                        np.mean([
+                            r["write_bandwidth_bytes_per_s"] or 0.0
+                            for r in recs
+                        ])
+                    ) / 2**30,
+                    3,
+                ),
+                "save_step_s": float(np.mean(save_steps)),
+                "quiet_step_s": float(np.mean(quiet_steps)),
+                "save_step_overhead_s": float(
+                    np.mean(save_steps) - np.mean(quiet_steps)
+                ),
+            }
+            # a sync-only pass is already a publishable blocked-time
+            # number; the async pass refines it into the ratio
+            partial.update(
+                phase=f"{mode}_done", iters_measured=iters,
+                metric="ckpt_async_save_blocked_seconds",
+                value=round(out[mode]["blocked_s"], 4), unit="s",
+                extra={mode: {k: round(v, 4) if isinstance(v, float) else v
+                              for k, v in out[mode].items()}},
+            )
+        finally:
+            shutil.rmtree(project_dir, ignore_errors=True)
+
+    sync_b, async_b = out["sync"]["blocked_s"], out["async"]["blocked_s"]
+    return {
+        "metric": "ckpt_async_save_blocked_seconds",
+        "value": round(async_b, 4),
+        "unit": "s",
+        "vs_baseline": round(sync_b / async_b, 3) if async_b > 0 else None,
+        "extra": {
+            "sync": {k: round(v, 4) if isinstance(v, float) else v
+                     for k, v in out["sync"].items()},
+            "async": {k: round(v, 4) if isinstance(v, float) else v
+                      for k, v in out["async"].items()},
+            "every_n_steps": every_n,
+            "params": n_params,
+            "device": _device_kind(),
+            "batch": batch_size, "seq": seq,
+        },
+    }
+
+
+def _run_accum(cfg, batch_size: int, seq: int, iters: int, warmup: int,
+               accum_steps: int = 8,
+               partial: Optional[PartialWriter] = None):
+    """Per-OPTIMIZER-step cost of gradient accumulation at K=accum_steps:
+    the fused ``lax.scan`` path (one dispatch per optimizer step over a
+    stacked ``[K, B, S]`` batch) vs the unfused per-microbatch
+    ``lax.cond`` path (K dispatches). Both modes run the same model for
+    the same number of optimizer steps; ``dispatches_per_opt_step`` is
+    read back from the telemetry step records (the field exists so this
+    win is visible in production sinks, not just here). ``vs_baseline``
+    is unfused/fused per-opt-step wall time: >= 1 means fused wins.
+    """
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import CausalLM, count_params
+    from accelerate_tpu.utils.dataclasses import GradientAccumulationPlugin
+
+    partial = partial or _noop_writer("accum")
+    K = accum_steps
+    out: dict[str, dict] = {}
+    n_params = 0
+    for mode in ("unfused", "fused"):
+        fused = mode == "fused"
+        _reset_state()
+        model = CausalLM(cfg)
+        acc = Accelerator(
+            mixed_precision="bf16",
+            gradient_accumulation_plugin=GradientAccumulationPlugin(
+                num_steps=K, fused=fused
+            ),
+            telemetry=True,
+        )
+        params = acc.prepare(
+            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))[
+                "params"
+            ]
+        )
+        n_params = count_params(params)
+        opt = acc.prepare(optax.adamw(3e-4))
+        carry = acc.init_carry(params, opt)
+        step = acc.unified_step(CausalLM.loss_fn(model), max_grad_norm=1.0)
+        ids = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch_size, seq)
+        ).astype(np.int32)
+        micro = {"input_ids": jnp.asarray(ids)}
+        batch = (
+            {"input_ids": jnp.asarray(np.stack([ids] * K))} if fused else micro
+        )
+        calls_per_opt_step = 1 if fused else K
+        for _ in range(warmup * calls_per_opt_step):
+            carry, metrics = step(carry, batch)
+        np.asarray(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters * calls_per_opt_step):
+            carry, metrics = step(carry, batch)
+        np.asarray(metrics["loss"])
+        dt = time.perf_counter() - t0
+        recs = [
+            r for r in acc.telemetry.records if r.get("kind") == "step"
+        ]
+        out[mode] = {
+            "opt_step_s": dt / iters,
+            "dispatches_per_opt_step": recs[-1]["dispatches_per_opt_step"],
+            "microbatches_per_record": recs[-1]["microbatches"],
+            "opt_steps_timed": iters,
+        }
+        partial.update(
+            phase=f"{mode}_done", iters_measured=iters,
+            metric="accum_fused_opt_step_seconds",
+            value=round(dt / iters, 4), unit="s",
+            extra={mode: {k: round(v, 4) if isinstance(v, float) else v
+                          for k, v in out[mode].items()},
+                   "accum_steps": K},
+        )
+
+    fused_s = out["fused"]["opt_step_s"]
+    unfused_s = out["unfused"]["opt_step_s"]
+    return {
+        "metric": "accum_fused_opt_step_seconds",
+        "value": round(fused_s, 4),
+        "unit": "s",
+        "vs_baseline": round(unfused_s / fused_s, 3) if fused_s > 0 else None,
+        "extra": {
+            "accum_steps": K,
+            "fused": {k: round(v, 4) if isinstance(v, float) else v
+                      for k, v in out["fused"].items()},
+            "unfused": {k: round(v, 4) if isinstance(v, float) else v
+                        for k, v in out["unfused"].items()},
+            "params": n_params,
+            "device": _device_kind(),
+            "batch": batch_size, "seq": seq,
+        },
+    }
+
+
+def _run_decode(cfg, batch_size: int, prompt_len: int, new_tokens: int,
+                reps: int, partial: Optional[PartialWriter] = None):
+    """Autoregressive generation benchmark -> (s/token, n_params).
+
+    Params are random-initialized DIRECTLY in bf16 on device (a standard
+    fp32 init of a ~5.5B model would not fit 16G); decode quality is
+    irrelevant to throughput — the per-token cost is reading the resident
+    weights once per step (memory-bound), which random weights measure
+    exactly.
+
+    Load time is measured by the separate ``decode_load`` helper variant
+    (folded into this line's extra as ``load_s``) so a slow or failed
+    load can never cost the decode headline.
+    """
+    from accelerate_tpu.models import CausalLM, count_params
+    from accelerate_tpu.models.generation import make_generate_fn
+    from accelerate_tpu.parallel.sharding import unbox_params
+
+    partial = partial or _noop_writer("decode")
+    _reset_state()
+    model = CausalLM(cfg)
+    abstract = unbox_params(
+        jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )
+        )
+    )["params"]
+    leaves, treedef = jax.tree_util.tree_flatten(abstract)
+    keys = jax.random.split(jax.random.PRNGKey(0), len(leaves))
+
+    @jax.jit
+    def init_bf16():
+        return jax.tree_util.tree_unflatten(treedef, [
+            jax.random.normal(k, l.shape, jnp.bfloat16)
+            * (0.02 if l.ndim > 1 else 1.0)
+            for k, l in zip(keys, leaves)
+        ])
+
+    params = init_bf16()
+    n_params = count_params(params)
+    gen = make_generate_fn(model, max_new_tokens=new_tokens)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch_size, prompt_len)
+        ),
+        jnp.int32,
+    )
+    out = gen(params, ids)
+    np.asarray(out[:, -1])  # full sync (compile + warmup)
+    partial.update(phase="warmup_done", iters_measured=0)
+    t0 = time.perf_counter()
+    for rep in range(1, reps + 1):
+        out = gen(params, ids)
+        np.asarray(out[:, -1])
+        dt = time.perf_counter() - t0
+        partial.update(
+            phase="measuring", iters_measured=rep,
+            metric="generate_seconds_per_token",
+            value=round(dt / (rep * new_tokens), 4), unit="s/token",
+            extra={"params": n_params, "device": _device_kind(),
+                   "batch": batch_size, "prompt_len": prompt_len,
+                   "new_tokens": new_tokens},
+        )
+    return dt / (reps * new_tokens), n_params
+
+
+def _run_decode_load(cfg, partial: Optional[PartialWriter] = None):
+    """Checkpoint-open -> device-resident seconds for the decode model
+    (VERDICT r4 missing #4: the reference's headline table couples load
+    seconds with s/token — GPT-J 8.7 s, benchmarks/README.md:31).
+
+    The sharded bf16 safetensors checkpoint is synthesized HOST-side
+    (same shapes the decode variant serves; writing from device would pay
+    an 11 GiB device->host pull that measures nothing). The timed section
+    is the real serving cold path users run: streamed
+    ``load_checkpoint_and_dispatch`` from disk to device-resident.
+    On this rig the chip is axon-tunneled at ~0.03 GiB/s each way, so
+    device residency is link-bound, not framework-bound — the
+    disk->host streaming time (the framework's own work) and the
+    host->device push are reported separately so the number stays
+    interpretable against the reference's local-PCIe 8.7 s.
+    """
+    import shutil
+    import tempfile
+
+    import ml_dtypes
+
+    from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+    from accelerate_tpu.checkpointing import save_model_weights
+    from accelerate_tpu.models import CausalLM, count_params
+    from accelerate_tpu.parallel.sharding import unbox_params
+
+    partial = partial or _noop_writer("decode_load")
+    _reset_state()
+    model = CausalLM(cfg)
+    abstract = unbox_params(
+        jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )
+        )
+    )["params"]
+    rng = np.random.default_rng(0)
+    host = jax.tree.map(
+        lambda l: rng.standard_normal(l.shape, np.float32)
+        .astype(ml_dtypes.bfloat16),
+        abstract,
+    )
+    n_params = count_params(host)
+    nbytes = sum(l.nbytes for l in jax.tree_util.tree_leaves(host))
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_decode_ckpt_")
+    try:
+        save_model_weights(host, ckpt_dir, max_shard_size="2GB")
+        del host
+        abstract_bf16 = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, jnp.bfloat16), abstract
+        )
+        from accelerate_tpu.big_modeling import _lazy_checkpoint_reader
+        from accelerate_tpu.checkpointing import _path_str
+
+        # attribution leg: the framework's own streaming work —
+        # checkpoint-open + assemble every tensor host-side, no jax
+        # placement (pure disk + numpy)
+        read = _lazy_checkpoint_reader(ckpt_dir)
+        flat, _ = jax.tree_util.tree_flatten_with_path(abstract_bf16)
+        t0 = time.perf_counter()
+        acc = 0
+        for path, _tmpl in flat:
+            acc += read(_path_str(path)).nbytes
+        disk_to_host_s = time.perf_counter() - t0
+        assert acc == nbytes
+        # the disk->host leg alone is a usable framework-side number if
+        # the tunnel-bound device push gets budget-killed
+        partial.update(
+            phase="disk_to_host_done", iters_measured=1,
+            metric="checkpoint_load_seconds",
+            value=round(disk_to_host_s, 2), unit="s",
+            extra={"disk_to_host_s": round(disk_to_host_s, 2),
+                   "gib": round(nbytes / 2**30, 2), "params": n_params},
+        )
+
+        # the serving cold path users run: checkpoint-open ->
+        # device-resident in one streamed call (peak host = one leaf)
+        t1 = time.perf_counter()
+        params = load_checkpoint_and_dispatch(
+            abstract_bf16, ckpt_dir, device_map={"": 0},
+        )
+        np.asarray(jax.tree_util.tree_leaves(params)[-1].ravel()[:1])
+        load_s = time.perf_counter() - t1
+        return {
+            "metric": "checkpoint_load_seconds",
+            "value": round(load_s, 2),
+            "unit": "s",
+            # reference pairs 8.7 s load with its decode headline
+            "vs_baseline": round(8.7 / load_s, 4),
+            "extra": {
+                "disk_to_host_s": round(disk_to_host_s, 2),
+                "host_to_device_s": round(load_s - disk_to_host_s, 2),
+                "gib": round(nbytes / 2**30, 2),
+                "params": n_params,
+                "load_ref_s": 8.7,
+                "note": "host->device rides the axon tunnel "
+                "(~0.03 GiB/s measured) — link-bound, not framework-bound; "
+                "disk_to_host_s is the framework's own streaming time",
+            },
+        }
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def _run_overhead(cfg, batch_size: int, seq: int, iters: int, warmup: int,
+                  partial: Optional[PartialWriter] = None):
+    """Telemetry+diagnostics ON-vs-OFF A/B: the harness proving ITSELF
+    cheap. The same train loop runs twice over the same compiled shapes —
+    once with the collector disabled (no per-step host sync), once with
+    telemetry AND the full diagnostics stack (goodput fold, anomaly
+    baselines, flight ring) — and the record reports
+    ``harness_overhead_pct``, the median-step-time delta. Medians, not
+    means: one GC pause or host scheduler hiccup must not fake an
+    overhead regression. ``vs_baseline`` is 2 / pct against the <2%
+    budget (>= 1 means the harness is within budget).
+
+    The two modes are measured in INTERLEAVED short chunks, not two
+    sequential phases: on a busy host the machine itself drifts
+    (allocator state, thermal throttle, background load) over the
+    seconds a phase takes, and a sequential A/B silently charges that
+    drift to whichever mode ran second. Alternating chunks puts both
+    modes through the same drift.
+
+    The ON mode runs with ``anomaly_sample_every=8``: the median/MAD
+    fold is the one non-O(1) piece of ``DiagnosticsManager.observe``,
+    and sampling it is exactly how a production loop with
+    sub-millisecond steps is expected to bound it. The record reports
+    the setting so the measurement is honest about its configuration.
+    """
+    import statistics
+
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.diagnostics import DiagnosticsConfig
+    from accelerate_tpu.models import CausalLM, count_params
+
+    partial = partial or _noop_writer("overhead")
+    _reset_state()
+    setups: dict[str, dict] = {}
+    n_params = 0
+    for mode in ("off", "on"):
+        model = CausalLM(cfg)
+        acc = Accelerator(
+            mixed_precision="bf16",
+            telemetry=(mode == "on"),
+            diagnostics=(
+                DiagnosticsConfig(anomaly_sample_every=8)
+                if mode == "on" else None
+            ),
+        )
+        params = acc.prepare(
+            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))[
+                "params"
+            ]
+        )
+        n_params = count_params(params)
+        opt = acc.prepare(optax.adamw(3e-4))
+        carry = acc.init_carry(params, opt)
+        step = acc.unified_step(CausalLM.loss_fn(model), max_grad_norm=1.0)
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(
+                0, cfg.vocab_size, (batch_size, seq)
+            ),
+            jnp.int32,
+        )
+        batch = {"input_ids": ids}
+        for _ in range(warmup):
+            carry, metrics = step(carry, batch)
+        np.asarray(metrics["loss"])
+        setups[mode] = {
+            "acc": acc, "carry": carry, "step": step, "batch": batch,
+            "times": [],
+        }
+        partial.update(
+            phase=f"{mode}_warm", iters_measured=0,
+            metric="harness_overhead_pct",
+        )
+
+    # short rounds: more pairs to median over, and a tighter time window
+    # per pair (less host drift inside each one)
+    chunk = max(1, min(3, iters // 6))
+    measured = 0
+    round_deltas: list[float] = []
+    while measured < iters:
+        n = min(chunk, iters - measured)
+        round_med = {}
+        for mode in ("off", "on"):
+            s = setups[mode]
+            ts = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                s["carry"], metrics = s["step"](s["carry"], s["batch"])
+                np.asarray(metrics["loss"])  # same sync in both modes
+                ts.append(time.perf_counter() - t0)
+            s["times"].extend(ts)
+            round_med[mode] = statistics.median(ts)
+        # pair the two chunks of THIS round: they sit in the same ~few-
+        # second window, so whatever the host was doing hits both
+        round_deltas.append(round_med["on"] - round_med["off"])
+        measured += n
+        partial.update(
+            phase="measuring", iters_measured=measured,
+            metric="harness_overhead_pct",
+        )
+
+    medians = {m: statistics.median(s["times"]) for m, s in setups.items()}
+    acc_on = setups["on"]["acc"]
+    records_on = sum(
+        1 for r in acc_on.telemetry.records if r.get("kind") == "step"
+    )
+    sample_every = (
+        acc_on.telemetry.diagnostics.config.anomaly_sample_every
+        if acc_on.telemetry.diagnostics is not None else None
+    )
+    for s in setups.values():
+        s["acc"].telemetry.close()
+
+    # the median of per-round deltas, not the delta of global medians:
+    # each delta already has that round's host conditions subtracted out
+    pct = statistics.median(round_deltas) / medians["off"] * 100.0
+    return {
+        "metric": "harness_overhead_pct",
+        "value": round(pct, 2),
+        "unit": "%",
+        # the harness's own acceptance bar: overhead must stay under 2%
+        "vs_baseline": round(2.0 / pct, 3) if pct > 0 else None,
+        "extra": {
+            "median_step_on_s": round(medians["on"], 6),
+            "median_step_off_s": round(medians["off"], 6),
+            "iters": iters,
+            "step_records_emitted_on": records_on,
+            "anomaly_sample_every": sample_every,
+            "params": n_params,
+            "device": _device_kind(),
+            "batch": batch_size, "seq": seq,
+        },
+    }
+
+
+def _compile_probe():
+    """Arm the process-wide CompileMonitor; the returned closure yields
+    the compile cost accrued since (JSON-ready). ``compile_time_s`` is
+    XLA backend-compile seconds — it does NOT accrue on a persistent-
+    cache hit, so warm-cache runs show the cache working: hits > 0,
+    compile_time_s ~ 0, and the headline step time is pure steady-state."""
+    from accelerate_tpu.compilation import (
+        get_compile_monitor,
+        persistent_cache_dir,
+    )
+
+    mon = get_compile_monitor()
+    before = mon.snapshot()
+
+    def done() -> dict:
+        delta = mon.delta(before)
+        return {
+            "compile_time_s": round(
+                float(delta.get("compile_time_s", 0.0)), 3
+            ),
+            "persistent_cache_hits": int(
+                delta.get("persistent_cache_hits", 0)
+            ),
+            "persistent_cache_misses": int(
+                delta.get("persistent_cache_misses", 0)
+            ),
+            "compile_cache_dir": persistent_cache_dir(),
+        }
+
+    return done
+
+
+def _goodput_fields(wall_s, productive_s, compile_s=0.0,
+                    checkpoint_s=0.0) -> dict:
+    """Variant-level goodput line: fold the quantities the bench already
+    measures through the production GoodputAccounting (synthetic `now`
+    injection — live per-step telemetry would add the per-step
+    block_until_ready the aggregate-timing design deliberately avoids).
+    `idle` is the unaccounted remainder: model init, prepare, warmup
+    steps, teardown."""
+    from accelerate_tpu.diagnostics.goodput import (
+        BADPUT_BUCKETS,
+        GoodputAccounting,
+    )
+
+    wall_s = max(float(wall_s), 1e-9)
+    g = GoodputAccounting(window_s=wall_s, now=0.0)
+    g.add("productive", float(productive_s), now=wall_s)
+    g.add("compile", float(compile_s), now=wall_s)
+    g.add("checkpoint", float(checkpoint_s), now=wall_s)
+    snap = g.snapshot(now=wall_s)
+    return {
+        "goodput_pct": round(snap["goodput_pct"], 1),
+        **{
+            f"badput_{b}_s": round(snap["buckets"][b], 3)
+            for b in BADPUT_BUCKETS
+        },
+    }
+
+
+def result_line(variant, partial: Optional[PartialWriter] = None) -> dict:
+    """Measure one registry :class:`~.registry.Variant` and build its
+    emitted record. ``extra.variant_wall_s`` is the whole-variant wall
+    cost (prepare + compile + warmup + timed loop) — the number the
+    scheduler persists as next round's estimate."""
+    name, kind = variant.name, variant.kind
+    cfg, batch_size, seq, iters, warmup = variant.args[:5]
+    optimizer = variant.args[5] if len(variant.args) > 5 else "adamw"
+    # compile attribution covers the WHOLE variant (prepare + warmup +
+    # timed loop) — any jit in the process accrues, so the emitted line
+    # separates total compile cost from the steady-state measurement
+    wall_t0 = time.perf_counter()
+    probe = _compile_probe()
+    checkpoint_s = 0.0
+    if kind == "decode_load":
+        rec = _run_decode_load(cfg, partial=partial)
+        rec["extra"].update(probe())
+        # a pure load/restore variant trains nothing: goodput is honestly 0
+        productive_s = 0.0
+    elif kind == "ckpt":
+        rec = _run_ckpt(cfg, batch_size, seq, iters, warmup, partial=partial)
+        rec["extra"].update(probe())
+        extra = rec["extra"]
+        productive_s = sum(
+            extra[m]["quiet_step_s"] * iters for m in ("sync", "async")
+        )
+        checkpoint_s = sum(
+            extra[m]["blocked_s"] * extra[m]["saves"] for m in ("sync", "async")
+        )
+    elif kind == "accum":
+        rec = _run_accum(cfg, batch_size, seq, iters, warmup, partial=partial)
+        rec["extra"].update(probe())
+        extra = rec["extra"]
+        productive_s = sum(
+            extra[m]["opt_step_s"] * extra[m]["opt_steps_timed"]
+            for m in ("fused", "unfused")
+        )
+    elif kind == "overhead":
+        rec = _run_overhead(
+            cfg, batch_size, seq, iters, warmup, partial=partial
+        )
+        rec["extra"].update(probe())
+        # both A/B loops are real measured steps
+        productive_s = (
+            rec["extra"]["median_step_on_s"]
+            + rec["extra"]["median_step_off_s"]
+        ) * iters
+    elif kind == "decode":
+        prompt_len, new_tokens, reps = seq, iters, warmup
+        s_token, n_params = _run_decode(
+            cfg, batch_size, prompt_len, new_tokens, reps, partial=partial
+        )
+        productive_s = s_token * new_tokens * reps
+        rec = {
+            "metric": "generate_seconds_per_token",
+            "value": round(s_token, 4),
+            "unit": "s/token",
+            # reference headline: GPT-J-6B fp16 at 0.05 s/token
+            # (benchmarks/README.md:31); >= 1 beats it
+            "vs_baseline": round(0.05 / s_token, 3),
+            "extra": {
+                "params": n_params,
+                "device": _device_kind(),
+                "batch": batch_size, "prompt_len": prompt_len,
+                "new_tokens": new_tokens,
+                **probe(),
+            },
+        }
+    else:
+        tps, step_time, n_params = _run(
+            cfg, batch_size, seq, iters, warmup, optimizer, partial=partial
+        )
+        mfu = _mfu(cfg, n_params, seq, tps)
+        productive_s = step_time * iters
+        rec = {
+            "metric": f"train_tokens_per_sec_per_chip_{name}"
+            if name != "dense" else "train_tokens_per_sec_per_chip",
+            "value": round(tps, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(mfu / 0.60, 4),
+            "extra": {
+                "step_time_s": round(step_time, 4),
+                "mfu": round(mfu, 4),
+                "params": n_params,
+                "device": _device_kind(),
+                "batch": batch_size, "seq": seq,
+                **probe(),
+            },
+        }
+    wall_s = time.perf_counter() - wall_t0
+    rec["extra"]["variant_wall_s"] = round(wall_s, 2)
+    rec["extra"].update(
+        _goodput_fields(
+            wall_s=wall_s,
+            productive_s=productive_s,
+            compile_s=rec["extra"].get("compile_time_s", 0.0),
+            checkpoint_s=checkpoint_s,
+        )
+    )
+    return rec

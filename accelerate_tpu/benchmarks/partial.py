@@ -1,0 +1,130 @@
+"""Partial-result streaming between a bench child and the scheduler.
+
+The r05 failure mode was binary: a variant either printed its one final
+JSON line or — when the driver's wall clock closed first — contributed
+nothing at all. The fix is a tmp **partial-result file** per variant:
+the measurement loops write a small JSON snapshot after warmup and every
+N measured iters (tmp file + flush + fsync + ``os.replace``, so a
+SIGKILL can never leave a torn read), and the parent, after killing a
+child at its budget, turns the last snapshot into a
+``{"partial": true, "iters_measured": k}`` record. A budget kill now
+costs precision, never the measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+#: directory the child writes ``partial_<variant>.json`` files into
+ENV_PARTIAL_DIR = "ACCELERATE_TPU_BENCH_PARTIAL_DIR"
+#: override the flush cadence (measured iters between fsync'd snapshots)
+ENV_PARTIAL_EVERY = "ACCELERATE_TPU_BENCH_PARTIAL_EVERY"
+
+
+def partial_path(directory: str, variant: str) -> str:
+    return os.path.join(directory, f"partial_{variant}.json")
+
+
+class PartialWriter:
+    """Child-side snapshot writer for one variant.
+
+    ``update`` is called from inside the measurement loop; every write is
+    atomic (tmp + fsync + rename) so the parent can read mid-kill. A
+    ``None`` path makes every method a no-op — measurement code calls the
+    writer unconditionally.
+    """
+
+    def __init__(self, path: Optional[str], variant: str,
+                 flush_every: Optional[int] = None):
+        self.path = path
+        self.variant = variant
+        if flush_every is None:
+            env = os.environ.get(ENV_PARTIAL_EVERY)
+            flush_every = int(env) if env else None
+        self.flush_every = flush_every
+        self._t0 = time.perf_counter()
+
+    def chunk(self, iters: int) -> int:
+        """Measured iters between snapshots: the env/ctor override, else
+        quarters of the loop (at least 1)."""
+        if self.flush_every:
+            return max(1, min(self.flush_every, iters))
+        return max(1, iters // 4)
+
+    def update(
+        self,
+        *,
+        phase: str,
+        iters_measured: int = 0,
+        elapsed_s: Optional[float] = None,
+        metric: Optional[str] = None,
+        value: Optional[float] = None,
+        unit: Optional[str] = None,
+        extra: Optional[dict] = None,
+    ) -> None:
+        if self.path is None:
+            return
+        payload = {
+            "variant": self.variant,
+            "phase": phase,
+            "iters_measured": int(iters_measured),
+            "elapsed_s": round(
+                time.perf_counter() - self._t0
+                if elapsed_s is None else float(elapsed_s), 4,
+            ),
+            "time_unix": time.time(),
+        }
+        if metric is not None:
+            payload["metric"] = metric
+        if value is not None:
+            payload["value"] = value
+        if unit is not None:
+            payload["unit"] = unit
+        if extra:
+            payload["extra"] = extra
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            # a full/readonly tmp disk must never fail the measurement
+            pass
+
+
+def read_partial(path: str) -> Optional[dict]:
+    """Parent-side read of the last committed snapshot (None when the
+    child died before its first write, or the file is unreadable)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def partial_record(snapshot: dict, *, reason: str = "budget") -> Optional[dict]:
+    """Turn a snapshot into a publishable result record, or None when the
+    child never measured anything usable (killed before/within warmup)."""
+    if snapshot is None or snapshot.get("value") is None:
+        return None
+    if not snapshot.get("iters_measured"):
+        return None
+    rec = {
+        "variant": snapshot["variant"],
+        "metric": snapshot.get("metric") or f"partial_{snapshot['variant']}",
+        "value": snapshot["value"],
+        "unit": snapshot.get("unit"),
+        "vs_baseline": None,
+        "partial": True,
+        "partial_reason": reason,
+        "iters_measured": int(snapshot["iters_measured"]),
+        "extra": dict(snapshot.get("extra") or {}),
+    }
+    rec["extra"].setdefault("phase", snapshot.get("phase"))
+    rec["extra"].setdefault("elapsed_s", snapshot.get("elapsed_s"))
+    return rec

@@ -1,0 +1,425 @@
+"""Parent-side bench orchestration.
+
+The runner walks the registry's process groups in priority order, asks
+the :class:`~.scheduler.DeadlineScheduler` for a runtime budget, launches
+one child per group (one spawn + one jax init per shared model config —
+the serial spawn/recompile tax that ate r05), and turns whatever comes
+back into the output stream:
+
+* every landed record is emitted IMMEDIATELY with ``"provisional": true``
+  (a driver wall-clock kill can no longer erase completed measurements);
+* a child killed at its budget yields the last fsync'd partial snapshot
+  as a ``{"partial": true, "iters_measured": k}`` record;
+* a variant that never ran emits ``{"skipped": "deadline", ...}``;
+* the consolidated final block re-prints folded records with the
+  headline LAST, for the parse-the-last-line driver.
+
+``launch``, ``emit``, ``log`` and the scheduler's clock are injectable so
+every path above is unit-testable without subprocesses or wall time.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from .partial import partial_path, partial_record, read_partial
+from .registry import Variant, VariantRegistry
+from .scheduler import DeadlineScheduler, Estimates, skip_record
+
+
+@dataclass
+class LaunchResult:
+    returncode: int
+    stdout: str
+    stderr: str
+    timed_out: bool = False
+
+
+class SubprocessLauncher:
+    """Spawn one bench child for a member list: ``python -m
+    accelerate_tpu.benchmarks --child <members...> --budget S
+    --partial-dir D``. The parent's ``timeout=`` is the hard budget
+    enforcement (SIGKILL); the child's ``--budget`` only lets it skip
+    later members it can see won't fit."""
+
+    def __init__(self, partial_dir: str):
+        self.partial_dir = partial_dir
+
+    def __call__(self, members: Sequence[str],
+                 budget_s: Optional[float]) -> LaunchResult:
+        cmd = [
+            sys.executable, "-m", "accelerate_tpu.benchmarks",
+            "--child", *members, "--partial-dir", self.partial_dir,
+        ]
+        timeout = None
+        if budget_s is not None and math.isfinite(budget_s):
+            timeout = max(1.0, float(budget_s))
+            cmd += ["--budget", f"{timeout:.1f}"]
+        env = dict(os.environ)
+        env["PYTHONUNBUFFERED"] = "1"
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+        env["PYTHONPATH"] = (
+            repo_root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else repo_root
+        )
+        try:
+            proc = subprocess.run(
+                cmd, text=True, capture_output=True, timeout=timeout, env=env,
+            )
+        except subprocess.TimeoutExpired as exc:
+            def _s(x):
+                if x is None:
+                    return ""
+                return x.decode(errors="replace") if isinstance(x, bytes) else x
+
+            return LaunchResult(-9, _s(exc.stdout), _s(exc.stderr),
+                                timed_out=True)
+        return LaunchResult(proc.returncode, proc.stdout, proc.stderr)
+
+
+def _implausible(rec: dict) -> bool:
+    # the tunneled chip occasionally degrades ~20x right after long
+    # multi-process sessions (observed: dense at 1.2k tok/s vs the usual
+    # 26k, recovering by itself a minute later) — a train variant
+    # reporting under 10% MFU on real hardware is that transient, not a
+    # real measurement
+    return (
+        rec.get("unit") == "tokens/s/chip"
+        and rec.get("extra", {}).get("mfu", 1.0) < 0.10
+        and not rec.get("partial")
+    )
+
+
+def _oom_line(err: str) -> Optional[str]:
+    return next(
+        (l.strip() for l in err.splitlines()
+         if "RESOURCE_EXHAUSTED" in l or "Ran out of memory" in l),
+        None,
+    )
+
+
+class BenchRunner:
+    def __init__(
+        self,
+        registry: VariantRegistry,
+        scheduler: DeadlineScheduler,
+        estimates: Estimates,
+        launch: Callable[[Sequence[str], Optional[float]], LaunchResult],
+        *,
+        partial_dir: Optional[str] = None,
+        emit: Optional[Callable[[str], None]] = None,
+        log: Optional[Callable[[str], None]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        settle_s: float = 60.0,
+        on_tpu: bool = True,
+    ):
+        self.registry = registry
+        self.scheduler = scheduler
+        self.estimates = estimates
+        self.launch = launch
+        self.partial_dir = partial_dir
+        self.emit = emit or (lambda s: print(s, flush=True))
+        self.log = log or (
+            lambda s: print(s, file=sys.stderr, flush=True)
+        )
+        self.sleep = sleep
+        # the tunnel transient recovers on its own within ~a minute; a
+        # retry without the settle usually measures the same degradation
+        self.settle_s = settle_s
+        self.on_tpu = on_tpu
+        self.results: dict[str, dict] = {}
+        self.errors: dict[str, str] = {}
+        self.skipped: list[dict] = []
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> int:
+        groups = self.registry.groups()
+        members = {g: [v.name for v in vs] for g, vs in groups}
+        variants = {v.name: v for _, vs in groups for v in vs}
+        items = [
+            (g, sum(self._estimate(v) for v in vs)) for g, vs in groups
+        ]
+        planned, plan_skips = self.scheduler.plan(items, members=members)
+        for sk in plan_skips:
+            for name in members[sk["variant"]]:
+                self._skip(variants[name], sk["remaining_s"])
+        reserved = [sum(p.budget_s for p in planned[i + 1:])
+                    for i in range(len(planned))]
+        for item, reserved_later in zip(planned, reserved):
+            group_members = [variants[n] for n in item.members]
+            budget = self.scheduler.grant(item, reserved_later_s=reserved_later)
+            if budget is None:
+                for v in group_members:
+                    self._skip(v, self.scheduler.deadline.remaining())
+                continue
+            self._run_group(group_members, budget)
+        self._fold()
+        self._final_block()
+        self.estimates.save()
+        headline = self.registry.headline
+        return 0 if headline in self.results else 1
+
+    # ------------------------------------------------------------ helpers
+    def _estimate(self, v: Variant) -> float:
+        return self.estimates.estimate(v.name, v.default_estimate_s)
+
+    def _skip(self, v: Variant, remaining_s: float) -> None:
+        rec = skip_record(v.name, self._estimate(v), remaining_s)
+        self.skipped.append(rec)
+        self.emit(json.dumps(rec))
+
+    def _publish(self, name: str, rec: dict) -> None:
+        rec.setdefault("variant", name)
+        self.results[name] = rec
+        # Emit the record the moment the variant lands, flushed, so a
+        # driver wall-clock kill cannot discard completed measurements
+        # (BENCH_r05 was rc=124 with an empty tail). The consolidated
+        # block at the end re-prints the FINAL (folded) records with the
+        # headline last — consumers of the whole stream skip provisional
+        # lines, the parse-the-last-line driver never sees them on a
+        # clean run.
+        self.emit(json.dumps({**rec, "provisional": True}))
+        extra = rec.get("extra", {})
+        if not rec.get("partial") and "variant_wall_s" in extra:
+            # feed the cost model: round n+1 schedules against this
+            self.estimates.observe(
+                name, extra["variant_wall_s"],
+                step_time_s=extra.get("step_time_s"),
+                compile_time_s=extra.get("compile_time_s"),
+            )
+
+    def _fail(self, name: str, err: str) -> None:
+        self.errors[name] = err
+        self.log(f"bench variant {name} failed (provisional): {err[:160]}")
+
+    def _parse(self, stdout: str) -> tuple[dict[str, dict], dict[str, dict]]:
+        """Split the child's JSON lines into (final records, child-side
+        skip records), keyed by variant name."""
+        recs: dict[str, dict] = {}
+        skips: dict[str, dict] = {}
+        for line in stdout.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            name = obj.get("variant")
+            if not name:
+                continue
+            if obj.get("skipped"):
+                skips[name] = obj
+            else:
+                recs[name] = obj
+        return recs, skips
+
+    def _harvest_partial(self, v: Variant, reason: str) -> bool:
+        """Turn the child's last fsync'd snapshot into a published
+        partial record. True when something usable was recovered."""
+        if not self.partial_dir:
+            return False
+        snap = read_partial(partial_path(self.partial_dir, v.name))
+        rec = partial_record(snap, reason=reason) if snap else None
+        if rec is None:
+            return False
+        self._publish(v.name, rec)
+        self.log(
+            f"variant {v.name} killed at its budget; recovered partial "
+            f"result at iters_measured={rec['iters_measured']}"
+        )
+        return True
+
+    # --------------------------------------------------------- group loop
+    def _run_group(self, group_members: list[Variant],
+                   budget_s: float) -> None:
+        pending = list(group_members)
+        first_recs: dict[str, dict] = {}
+        budget = budget_s
+        for attempt in (0, 1):
+            res = self.launch([v.name for v in pending], budget)
+            recs, child_skips = self._parse(res.stdout)
+            retry: list[Variant] = []
+            crashed: list[Variant] = []
+            for v in pending:
+                if v.name in child_skips:
+                    self.skipped.append(child_skips[v.name])
+                    self.emit(json.dumps(child_skips[v.name]))
+                    continue
+                rec = recs.get(v.name)
+                if rec is not None:
+                    prior = first_recs.get(v.name)
+                    if (
+                        prior is None and attempt == 0 and self.on_tpu
+                        and _implausible(rec)
+                    ):
+                        first_recs[v.name] = rec
+                        retry.append(v)
+                        continue
+                    if prior is not None:
+                        # keep the better of the two attempts: a
+                        # genuinely-slow variant measures the same twice
+                        # (the number stands), the degraded-chip
+                        # transient recovers on the retry
+                        if prior.get("value", 0) > rec.get("value", 0):
+                            rec = prior
+                        rec["extra"]["retried"] = True
+                    self._publish(v.name, rec)
+                    continue
+                if res.timed_out:
+                    if not self._harvest_partial(v, reason="budget"):
+                        self._fail(v.name, f"timeout after {budget:.0f}s")
+                else:
+                    crashed.append(v)
+            # CRASH path. Round 3 lost its dense headline here: the crash
+            # was a transient tunnel error but only implausibly-slow
+            # *successes* were retried. Retry crashes once after a settle
+            # — except deterministic OOMs, where a retry just re-pays the
+            # compile (and for the longseq_xla variants OOM is the
+            # expected, informative outcome).
+            if crashed:
+                err = (res.stderr or "no output").strip()
+                oom = _oom_line(err)
+                if oom or attempt == 1:
+                    for v in crashed:
+                        self._fail(v.name, oom or err[-300:] or "no output")
+                    crashed = []
+            pending = retry + crashed
+            if not pending or attempt == 1:
+                break
+            if res.timed_out:
+                # a timeout is NOT retried: another budget would risk the
+                # global window — fall through to the first_rec fallback
+                break
+            rem = self.scheduler.deadline.remaining()
+            need = sum(self._estimate(v) for v in pending)
+            if need > rem - self.settle_s:
+                break  # the window can't fund a retry
+            what = "implausibly slow" if retry else "crashed"
+            self.log(
+                f"variant(s) {[v.name for v in pending]} {what}; retrying "
+                f"after a {self.settle_s:.0f}s settle"
+            )
+            self.sleep(self.settle_s)
+            if math.isfinite(budget):
+                budget = min(budget, self.scheduler.deadline.remaining())
+        # fallback: an implausible-but-MEASURED first attempt whose retry
+        # timed out, crashed, or could not be funded is still a
+        # measurement — publish it marked retried+partial instead of
+        # erroring (the old bench.py timeout path silently discarded it)
+        variants = {v.name: v for v in group_members}
+        for name, prior in first_recs.items():
+            if name in self.results:
+                continue
+            self.errors.pop(name, None)
+            prior["extra"]["retried"] = True
+            prior["extra"]["implausible"] = True
+            prior["partial"] = True
+            prior["partial_reason"] = "retry_failed"
+            v = variants[name]
+            if "iters_measured" not in prior:
+                prior["iters_measured"] = (
+                    int(v.args[3]) if len(v.args) > 3 else 0
+                )
+            self._publish(name, prior)
+        for v in pending:
+            if v.name not in self.results and v.name not in self.errors:
+                self._fail(v.name, "retry window exhausted")
+
+    # ------------------------------------------------------------ folding
+    def _fold(self) -> None:
+        results, errors = self.results, self.errors
+        # fold the load-time helper into the decode line (never the
+        # reverse: a failed load leaves the decode headline intact with
+        # load_s null)
+        if "decode" in results:
+            extra = results["decode"]["extra"]
+            if "decode_load" in results:
+                rec_l = results.pop("decode_load")
+                extra["load_s"] = rec_l["value"]
+                le = rec_l["extra"]
+                extra["load_disk_to_host_s"] = le.get("disk_to_host_s")
+                extra["load_host_to_device_s"] = le.get("host_to_device_s")
+                extra["load_gib"] = le.get("gib")
+                extra["load_ref_s"] = 8.7
+                if "note" in le:
+                    extra["load_note"] = le["note"]
+                if rec_l.get("partial"):
+                    extra["load_partial"] = True
+            elif "decode_load" in errors:
+                extra["load_s"] = None
+                extra["load_error"] = errors.pop("decode_load")[:160]
+            elif any(s["variant"] == "decode_load" for s in self.skipped):
+                extra["load_s"] = None
+                extra["load_skipped"] = "deadline"
+
+        helpers = ("longseq_xla", "longseq4k", "longseq_xla4k")
+        if "longseq" in results:
+            extra = results["longseq"]["extra"]
+            if "longseq_xla" in results:
+                xla_step = results["longseq_xla"]["extra"]["step_time_s"]
+                extra["xla_step_time_s"] = xla_step
+                extra["flash_speedup_vs_xla"] = round(
+                    xla_step / extra["step_time_s"], 3
+                )
+            else:
+                # numeric fields stay numeric (None) for machine
+                # consumers; the error text gets its own key
+                extra["xla_step_time_s"] = None
+                extra["flash_speedup_vs_xla"] = None
+                if "longseq_xla" in errors:
+                    extra["xla_error"] = errors.pop("longseq_xla")[:160]
+            # the S=4096 pair, where dense attention fits 16G: always
+            # record whichever step times landed (even a lone one — never
+            # discard a valid measurement), and let the pair supply the
+            # headline speedup when the S=8192 dense point failed (null
+            # in rounds 2 and 3)
+            if "longseq4k" in results:
+                extra["flash_step_s_s4096"] = (
+                    results["longseq4k"]["extra"]["step_time_s"]
+                )
+            if "longseq_xla4k" in results:
+                extra["xla_step_s_s4096"] = (
+                    results["longseq_xla4k"]["extra"]["step_time_s"]
+                )
+            if "longseq4k" in results and "longseq_xla4k" in results:
+                flash4k = results["longseq4k"]["extra"]["step_time_s"]
+                xla4k = results["longseq_xla4k"]["extra"]["step_time_s"]
+                if extra["flash_speedup_vs_xla"] is None:
+                    extra["flash_speedup_vs_xla"] = round(xla4k / flash4k, 3)
+                    extra["speedup_measured_at_seq"] = 4096
+                    extra["speedup_optimizer"] = "sgd"
+            for name in helpers:
+                results.pop(name, None)
+        # when longseq itself failed, measured helper records stay in
+        # ``results`` and print as their own lines — a valid measurement
+        # is never silently discarded
+
+    def _final_block(self) -> None:
+        headline = self.registry.headline
+        order = [n for n in self.results if n != headline]
+        if headline in self.results:
+            order.append(headline)
+        for name in order:
+            self.emit(json.dumps(self.results[name]))
+        for name, err in self.errors.items():
+            qualifier = (
+                " (expected on 16G chips — the dense-attention comparison"
+                " point)"
+                if name == "longseq_xla" else ""
+            )
+            self.log(f"bench variant {name} failed{qualifier}: {err}")
+        if self.skipped:
+            self.log(
+                "skipped (deadline): "
+                + ", ".join(sorted({s["variant"] for s in self.skipped}))
+            )

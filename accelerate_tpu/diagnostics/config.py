@@ -41,7 +41,12 @@ class DiagnosticsConfig:
     ``nan_grad`` immediately. Each type is rate-limited to one
     ``kind="anomaly"`` record per ``anomaly_cooldown_steps`` steps (and
     ``anomaly_cooldown_s`` seconds); suppressed repeats are counted on
-    the next record.
+    the next record. ``anomaly_sample_every``: observe the median/MAD
+    baselines only every Nth step record (NaN/inf detection still runs
+    on EVERY record — a skipped NaN is a lost run). The baseline fold
+    sorts the rolling window (O(w log w) per observation host-side);
+    sampling makes the per-step cost O(1) amortized for sub-millisecond
+    steps where even that shows up. 1 (default) checks every step.
 
     **Triggered trace capture** — when an anomaly fires (or
     ``trigger_file`` appears / SIGUSR1 arrives), the next
@@ -70,6 +75,7 @@ class DiagnosticsConfig:
     mad_z: float = 8.0
     anomaly_cooldown_steps: int = 50
     anomaly_cooldown_s: float = 30.0
+    anomaly_sample_every: int = 1
     # triggered trace capture
     trace_dir: Optional[str] = None
     capture_steps: int = 3
@@ -98,6 +104,8 @@ class DiagnosticsConfig:
             raise ValueError("anomaly_min_samples must be <= anomaly_window")
         if self.slow_step_factor <= 1.0:
             raise ValueError("slow_step_factor must be > 1")
+        if self.anomaly_sample_every < 1:
+            raise ValueError("anomaly_sample_every must be >= 1")
         if self.capture_steps < 1:
             raise ValueError("capture_steps must be >= 1")
         if self.max_captures < 0:

@@ -57,6 +57,14 @@ class DiagnosticsManager:
         emit path, so they land in the ring and every sink — they come
         back here once, get archived in the flight ring, and derive
         nothing further (no recursion).
+
+        This runs on the train-loop thread, so its cost IS harness
+        overhead. Everything here is O(1) per step except the anomaly
+        median/MAD fold, which sorts its rolling window; with
+        ``DiagnosticsConfig.anomaly_sample_every > 1`` that fold runs on
+        every Nth step only (NaN detection still every step), making the
+        whole path O(1) amortized — the bench's ON-vs-OFF ``overhead``
+        variant measures the result as ``harness_overhead_pct``.
         """
         kind = record.get("kind")
         if kind in ("anomaly", "goodput"):

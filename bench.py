@@ -1,1051 +1,23 @@
-"""Training-throughput benchmark matrix on the available accelerator.
+"""Single-host benchmark entry point — thin shim over
+:mod:`accelerate_tpu.benchmarks`.
 
-Prints one JSON line PER CONFIG; the HEADLINE dense line prints LAST (the
-driver parses the final line). TPU matrix (VERDICT r2 weak #5: the perf
-story must not rest on one config):
+Emits one JSON line per variant to stdout; an outer driver parses the
+LAST line for the headline number, so the consolidated final block
+prints ``dense`` last. Streaming semantics (provisional / partial /
+skipped records), the deadline scheduler, and the variant registry live
+in the package — see ``accelerate_tpu/benchmarks/`` and the README's
+"Benchmarking" section.
 
-  * dense    — ~916M Llama-width model, S=1024 (the headline MFU number);
-               RUNS first (fresh chip — round 3 lost this line to a
-               late-session tunnel transient), prints last
-  * moe      — Mixtral-family slice (EP-family FLOPs)
-  * longseq  — dense model at S=8192 on the flash kernel (the regime the
-               O(S) kernel exists for), with a flash-vs-xla step-time
-               delta measured at the same shapes when the dense path fits,
-               and ALWAYS at S=4096 (where dense attention fits 16G), so
-               the speedup field cannot be null
-  * decode   — GPT-J-class 5.5B bf16 generation in s/token (the
-               reference's published headline, benchmarks/README.md:31)
-
-Each line: {"metric", "value", "unit", "vs_baseline", "extra"}.
-For training lines ``vs_baseline`` = achieved MFU / 0.60 (BASELINE.md
-north-star >=60% MFU); for the decode line it is 0.05 / (s/token), i.e.
-the speedup over the reference's GPT-J-6B generation number. >= 1.0
-means "meets/beats the reference target" in both cases.
+Usage:
+    python bench.py                      # full matrix for this backend
+    python bench.py --fast --deadline 120
+    python bench.py accum                # one variant, in-process
+    python bench.py --list
 """
 
-from __future__ import annotations
-
-import json
 import sys
-import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-
-# bf16 peak FLOPs per chip by device kind (public cloud specs)
-PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-    "cpu": 1e12,  # nominal, so vs_baseline stays defined on CPU test runs
-}
-
-
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu")
-    for name, flops in PEAK_FLOPS.items():
-        if name.lower() in str(kind).lower():
-            return flops
-    return 197e12 if device.platform == "tpu" else 1e12
-
-
-def _configs(on_tpu: bool):
-    from accelerate_tpu.models import TransformerConfig
-
-    if not on_tpu:  # CI/CPU smoke: tiny shapes, same code paths
-        return {
-            "dense": (TransformerConfig.tiny(), 4, 128, 3, 1),
-            "moe": (
-                TransformerConfig.tiny(num_experts=4, num_experts_per_tok=2),
-                4, 128, 3, 1,
-            ),
-            "ckpt": (TransformerConfig.tiny(), 4, 64, 8, 2),
-            "accum": (TransformerConfig.tiny(), 4, 64, 6, 2),
-        }
-    dense = TransformerConfig(
-        # ~916M params (Llama-8B width, depth cut to fit one 16G v5e chip
-        # with fp32 master + AdamW state). remat="dots" saves matmul
-        # outputs so backward recomputes only elementwise ops — measured
-        # ~11% faster than remat="full" at this size.
-        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
-        num_layers=3, num_heads=32, num_kv_heads=8, max_seq_len=1024,
-        dtype="bfloat16", remat="dots",
-    )
-    moe = TransformerConfig(
-        # Mixtral-family slice (BASELINE.md supporting config): 8 experts,
-        # top-2, MIXTRAL-WIDTH experts (h=4096 — expert matmul width is
-        # what drives MXU efficiency), depth cut to fit fp32 master +
-        # AdamW on one 16G v5e chip. Round-4 single-chip sweep (20 iters,
-        # B=16, S=1024, tokens/s/chip -> MFU):
-        #   h=1024 L=4 capacity/dots   74.1k  0.311   (round-3 config)
-        #   h=1024 L=4 ragged/dots_rg  74.5k  0.312
-        #   h=2048 L=2 capacity/dots   53.5k  0.380
-        #   h=4096 L=1 capacity/dots   58.7k  0.475
-        #   h=4096 L=1 capacity/none   60.7k  0.490
-        #   h=4096 L=1 ragged/dots_rg  62.9k  0.509
-        #   h=4096 L=1 ragged/none     63.8k  0.516   <- this config
-        # ragged (exact, no capacity padding or drops) beats capacity-1.25
-        # at every width once remat stops recomputing ragged_dot; at L=1
-        # no remat is needed at all.
-        #
-        # r5 structural bound for the residual vs the 0.60 bar (xplane
-        # trace of 3 steps on v5e + ablations, all at this exact shape):
-        #   per-step device time: 29.2% lm_head matmuls (49.4% of counted
-        #   FLOPs — ~0.88 MFU-equiv), 26.7% expert ragged_dots (33.2% of
-        #   FLOPs — ~0.64), 14.3% attention path (1.6% of FLOPs; shared
-        #   with every other line), ~10.5% moe dispatch machinery
-        #   (scatter-add combine ~5.5%, routed gathers ~2.1%, router +
-        #   combine-weight math ~2.9%, the argsort itself ~0%), ~9%
-        #   AdamW update + bf16-cast traffic on the FULL 8-expert stacks
-        #   (all experts train, only K=2 compute — MFU's active-FLOPs
-        #   accounting correctly charges this as overhead), 3.5% loss
-        #   log_softmax over the f32 (16,1023,32000) logits.
-        # Ablations: a dense MLP with IDENTICAL active matmul FLOPs
-        # (f=7168, no routing) measures 81.8k tok/s = 0.661 MFU — the
-        # no-dispatch skeleton ceiling; 0.518 = 0.661 x (200.2/254.3 ms).
-        # Combine alternatives measured: inverse-permutation gather+sum
-        # is 2.7% SLOWER than the scatter-add (261.3 vs 254.3 ms);
-        # folding combine weights into the w_down ragged_dot input is
-        # noise (+0.4%). Even with dispatch entirely free, the
-        # all-expert AdamW/cast traffic (~23 ms) exceeds the 19.3 ms
-        # gap to 0.60 — the shape's ceiling under AdamW is ~0.59, so
-        # 0.52 stands as measured, bounded, and attributed rather than
-        # unexplained.
-        vocab_size=32000, hidden_size=4096, intermediate_size=3584,
-        num_layers=1, num_heads=32, num_kv_heads=8, max_seq_len=1024,
-        num_experts=8, num_experts_per_tok=2, moe_dispatch="ragged",
-        moe_capacity_factor=1.25, dtype="bfloat16", remat=None,
-    )
-    longseq = TransformerConfig(
-        # the long-context regime (VERDICT r2 #10: the S=8k single-chip
-        # flash point): S^2 score tensors never materialize. Round-4
-        # remat sweep at this shape (B=1, adamw, MFU):
-        #   L=3 remat="full"       0.475   (round-3 config; 0.63 dense
-        #       ceiling x 6/8 full-recompute bound = 0.47 — the number
-        #       is exactly the remat tax, not kernel inefficiency)
-        #   L=3 remat="save_attn"  0.474   (kernel fwd recompute is tiny)
-        #   L=3 remat="dots"       OOM     (saves every matmul output)
-        #   L=3 remat="save_mlp"   OOM by 1.0G (AdamW state crowds it out)
-        #   L=2 remat="full"       0.473
-        #   L=2 remat="save_mlp"   0.505   <- this config (keeps f-wide
-        #       MLP activations; backward recomputes only the attn path)
-        # Residual gap to 0.60 is structural at B=1/S=8192: ~11% of
-        # counted FLOPs are attention (flash bwd runs below dense-matmul
-        # MXU efficiency) plus the remaining attn-path recompute.
-        # r5: the one lever the accounting pointed at — a fused
-        # single-pass flash backward (5 matmuls/pair vs two-pass's 7) —
-        # was built and MEASURED at this shape: 8,137 ms/step vs the
-        # two-pass 310/312 ms (chip re-verified healthy between runs).
-        # TPU Pallas's consecutive-output-visit rule forces the fused
-        # form through a collapsing index map + full-sequence VMEM
-        # scratch that defeats Mosaic pipelining (and 1024-blocks
-        # overflow the 16 MiB scoped vmem). The two-pass backward is
-        # the structural optimum here — see ops/flash_attention.py's
-        # FUSED_BWD block for the full record.
-        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
-        num_layers=2, num_heads=32, num_kv_heads=8, max_seq_len=8192,
-        dtype="bfloat16", remat="save_mlp", attention_impl="flash",
-    )
-    import dataclasses
-
-    decode = TransformerConfig(
-        # GPT-J-6B-class decoder (~5.5B params, bf16-resident ~11G on the
-        # 16G chip) for the reference's HEADLINE metric: big-model
-        # generation s/token (benchmarks/README.md:31 — GPT-J-6B fp16 at
-        # 0.05 s/token on 2x Titan RTX)
-        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
-        num_layers=24, num_heads=32, num_kv_heads=8, max_seq_len=512,
-        dtype="bfloat16",
-    )
-    # Dict order IS run order: dense FIRST on the fresh chip (round 3 lost
-    # the headline to a transient after four heavy variants had stressed
-    # the tunnel; the driver parses the LAST printed line, so print order
-    # is handled separately in main()).
-    return {
-        "dense": (dense, 8, 1024, 20, 3),
-        "moe": (moe, 16, 1024, 20, 3),
-        "longseq": (longseq, 1, 8192, 8, 2),
-        # same shapes on the dense-attention path: the flash-vs-xla delta
-        # (runs in its own subprocess so leftover flash HBM can't falsely
-        # fail it; expected to OOM on 16G chips — itself the flash story)
-        "longseq_xla": (
-            dataclasses.replace(longseq, attention_impl="xla"), 1, 8192, 4, 2,
-        ),
-        # S=4096 comparison pair, where the dense-attention path FITS 16G:
-        # guarantees a non-null flash_speedup_vs_xla even when the S=8192
-        # xla point OOMs/fails (it was null in rounds 2 and 3). Both run
-        # under SGD (6th tuple slot): with AdamW the ~916M model carries
-        # ~11G of fp32 master+m+v state and the xla side's fp32 S^2 score
-        # tensors push past 16G (measured: 18.26G at S=4096) — the
-        # flash/xla RATIO is what this pair exists for, and it is
-        # optimizer-invariant as long as both sides match. remat="full"
-        # on BOTH sides isolates the kernel delta (measured ~1.5x: 1.473
-        # at L=2, 1.515 at L=3; under "save_mlp" the saved f-wide buffers
-        # perturb the flash side's fusion and the ratio drops to 1.14x
-        # while measuring remat interplay, not the kernel).
-        "longseq4k": (
-            dataclasses.replace(longseq, max_seq_len=4096, remat="full"),
-            1, 4096, 8, 2, "sgd",
-        ),
-        "longseq_xla4k": (
-            dataclasses.replace(
-                longseq, max_seq_len=4096, attention_impl="xla",
-                remat="full",
-            ), 1, 4096, 8, 2, "sgd",
-        ),
-        # gradient accumulation at K=8: fused lax.scan (1 dispatch/opt
-        # step) vs unfused per-microbatch lax.cond (K dispatches). Modest
-        # width — the metric is per-opt-step wall time and dispatch count,
-        # not MFU, so it only needs enough compute that dispatch overhead
-        # is visible next to it.
-        "accum": (
-            TransformerConfig(
-                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-                num_layers=2, num_heads=16, num_kv_heads=8,
-                max_seq_len=512, dtype="bfloat16",
-            ),
-            4, 512, 8, 2,
-        ),
-        "decode": (decode, 1, 128, 64, 1),  # B, prompt_len, new_tokens, reps
-        # checkpoint-open -> device-resident for the decode model; its own
-        # variant so a slow/failed load can never cost the decode headline
-        # (folded into the decode line's extra as load_s)
-        "decode_load": (decode, 1, 0, 0, 0),
-        # checkpoint step-time perturbation, sync vs async saves. LAST so
-        # its disk IO (a ~1 GiB carry written 4x per mode) can never
-        # perturb the throughput headlines. Modest width: the metric is
-        # blocked-time per save, which only needs enough bytes that the
-        # serialize+write cost is unmistakable next to a step.
-        "ckpt": (
-            TransformerConfig(
-                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-                num_layers=2, num_heads=16, num_kv_heads=8,
-                max_seq_len=512, dtype="bfloat16",
-            ),
-            8, 512, 16, 3,
-        ),
-    }
-
-
-def _reset_state():
-    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
-
-    AcceleratorState._reset_state()
-    GradientState._reset_state()
-    PartialState._reset_state()
-
-
-def _run(cfg, batch_size: int, seq: int, iters: int, warmup: int,
-         optimizer: str = "adamw"):
-    """Train-step throughput for one config -> (tokens/s/chip, step_s, n_params)."""
-    import optax
-
-    from accelerate_tpu import Accelerator
-    from accelerate_tpu.models import CausalLM, count_params
-
-    _reset_state()
-    model = CausalLM(cfg)
-    acc = Accelerator(mixed_precision="bf16")
-    params = acc.prepare(
-        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))["params"]
-    )
-    n_params = count_params(params)
-    opt = acc.prepare(
-        optax.adamw(3e-4) if optimizer == "adamw" else optax.sgd(3e-4)
-    )
-    carry = acc.init_carry(params, opt)
-    step = acc.unified_step(CausalLM.loss_fn(model), max_grad_norm=1.0)
-
-    ids = jnp.asarray(
-        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch_size, seq)),
-        jnp.int32,
-    )
-    batch = {"input_ids": ids}
-
-    # sync by fetching a scalar that depends on the whole step chain
-    # (axon quirk: block_until_ready is unreliable/slow through the tunnel)
-    for _ in range(warmup):
-        carry, metrics = step(carry, batch)
-    np.asarray(metrics["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        carry, metrics = step(carry, batch)
-    np.asarray(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    step_time = dt / iters
-    tokens_per_sec_chip = batch_size * seq / step_time / jax.device_count()
-    return tokens_per_sec_chip, step_time, n_params
-
-
-def _mfu(cfg, n_params: int, seq: int, tokens_per_sec_chip: float) -> float:
-    # Honest model-FLOP accounting (remat recompute NOT counted — standard
-    # MFU convention):
-    #   * 6N counts only matmul-active params: the untied input embedding
-    #     is a gather in forward (no MXU work), so it is excluded; lm_head
-    #     is a real matmul and stays in (tied embeddings would count once).
-    #   * attention: QK^T + PV are 4*S*(nh*hd) fwd flops/token/layer, 3x
-    #     for fwd+bwd = 12*S*(nh*hd), halved for causal masking (the flash
-    #     kernel really skips the masked blocks) -> 6*S*nh*hd per layer.
-    matmul_params = n_params
-    if not cfg.tie_embeddings:
-        matmul_params -= cfg.vocab_size * cfg.hidden_size
-    if cfg.num_experts > 0:
-        # sparse MoE: each token computes only K of E experts — count the
-        # ACTIVE expert params (capacity-padding overhead is real runtime
-        # but not useful FLOPs, so it correctly depresses MFU)
-        expert_params = (
-            cfg.num_experts * 3 * cfg.hidden_size * cfg.intermediate_size
-            * cfg.num_layers
-        )
-        matmul_params -= expert_params
-        matmul_params += (
-            expert_params * cfg.num_experts_per_tok // cfg.num_experts
-        )
-    attn_flops_per_token = 6 * seq * cfg.num_heads * cfg.head_dim * cfg.num_layers
-    flops_per_token = 6 * matmul_params + attn_flops_per_token
-    return tokens_per_sec_chip * flops_per_token / _peak_flops(jax.devices()[0])
-
-
-def _run_ckpt(cfg, batch_size: int, seq: int, iters: int, warmup: int):
-    """Step-time perturbation of cadence checkpoints: sync vs async saves.
-
-    Runs the SAME train loop twice (fresh state each time), saving every
-    few steps through CheckpointManager — once synchronously, once through
-    the async subsystem — and reports the train-loop-blocked seconds per
-    save (the new ``kind="checkpoint"`` telemetry field) plus the step-time
-    spike a save adds on top of a quiet step. ``vs_baseline`` is
-    sync_blocked / async_blocked: >= 1 means async hides the IO.
-    """
-    import shutil
-    import tempfile
-
-    import optax
-
-    from accelerate_tpu import Accelerator, CheckpointManager, ProjectConfiguration
-    from accelerate_tpu.models import CausalLM, count_params
-
-    every_n = max(2, iters // 4)
-    out: dict[str, dict] = {}
-    n_params = 0
-    for mode in ("sync", "async"):
-        _reset_state()
-        project_dir = tempfile.mkdtemp(prefix=f"bench_ckpt_{mode}_")
-        try:
-            model = CausalLM(cfg)
-            acc = Accelerator(
-                mixed_precision="bf16",
-                project_config=ProjectConfiguration(
-                    project_dir=project_dir,
-                    automatic_checkpoint_naming=True,
-                    total_limit=2,
-                ),
-                telemetry=True,
-            )
-            params = acc.prepare(
-                model.init(
-                    jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
-                )["params"]
-            )
-            n_params = count_params(params)
-            opt = acc.prepare(optax.adamw(3e-4))
-            carry = acc.init_carry(params, opt)
-            step = acc.unified_step(CausalLM.loss_fn(model))
-            ids = jnp.asarray(
-                np.random.default_rng(0).integers(
-                    0, cfg.vocab_size, (batch_size, seq)
-                ),
-                jnp.int32,
-            )
-            batch = {"input_ids": ids}
-            for _ in range(warmup):
-                carry, metrics = step(carry, batch)
-            np.asarray(metrics["loss"])
-
-            mgr = CheckpointManager(
-                acc, every_n_steps=every_n, handle_signals=False,
-                async_saves=(mode == "async"),
-            )
-            save_steps, quiet_steps = [], []
-            for i in range(1, iters + 1):
-                t0 = time.perf_counter()
-                carry, metrics = step(carry, batch)
-                np.asarray(metrics["loss"])  # step fully done before the save
-                saved = mgr.step(carry)
-                dt = time.perf_counter() - t0
-                (save_steps if saved else quiet_steps).append(dt)
-            mgr.wait()
-            mgr.close()
-            recs = [
-                r for r in acc.telemetry.records
-                if r.get("kind") == "checkpoint"
-            ]
-            out[mode] = {
-                "saves": len(recs),
-                "blocked_s": float(np.mean([r["blocked_s"] for r in recs])),
-                "background_s": float(
-                    np.mean([r["background_s"] for r in recs])
-                ),
-                "bytes_written": int(recs[-1]["bytes_written"]),
-                "write_bandwidth_gib_s": round(
-                    float(
-                        np.mean([
-                            r["write_bandwidth_bytes_per_s"] or 0.0
-                            for r in recs
-                        ])
-                    ) / 2**30,
-                    3,
-                ),
-                "save_step_s": float(np.mean(save_steps)),
-                "quiet_step_s": float(np.mean(quiet_steps)),
-                "save_step_overhead_s": float(
-                    np.mean(save_steps) - np.mean(quiet_steps)
-                ),
-            }
-        finally:
-            shutil.rmtree(project_dir, ignore_errors=True)
-
-    sync_b, async_b = out["sync"]["blocked_s"], out["async"]["blocked_s"]
-    return {
-        "metric": "ckpt_async_save_blocked_seconds",
-        "value": round(async_b, 4),
-        "unit": "s",
-        "vs_baseline": round(sync_b / async_b, 3) if async_b > 0 else None,
-        "extra": {
-            "sync": {k: round(v, 4) if isinstance(v, float) else v
-                     for k, v in out["sync"].items()},
-            "async": {k: round(v, 4) if isinstance(v, float) else v
-                      for k, v in out["async"].items()},
-            "every_n_steps": every_n,
-            "params": n_params,
-            "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
-            "batch": batch_size, "seq": seq,
-        },
-    }
-
-
-def _run_accum(cfg, batch_size: int, seq: int, iters: int, warmup: int,
-               accum_steps: int = 8):
-    """Per-OPTIMIZER-step cost of gradient accumulation at K=accum_steps:
-    the fused ``lax.scan`` path (one dispatch per optimizer step over a
-    stacked ``[K, B, S]`` batch) vs the unfused per-microbatch
-    ``lax.cond`` path (K dispatches). Both modes run the same model for
-    the same number of optimizer steps; ``dispatches_per_opt_step`` is
-    read back from the telemetry step records (the field exists so this
-    win is visible in production sinks, not just here). ``vs_baseline``
-    is unfused/fused per-opt-step wall time: >= 1 means fused wins.
-    """
-    import optax
-
-    from accelerate_tpu import Accelerator
-    from accelerate_tpu.models import CausalLM, count_params
-    from accelerate_tpu.utils.dataclasses import GradientAccumulationPlugin
-
-    K = accum_steps
-    out: dict[str, dict] = {}
-    n_params = 0
-    for mode in ("unfused", "fused"):
-        fused = mode == "fused"
-        _reset_state()
-        model = CausalLM(cfg)
-        acc = Accelerator(
-            mixed_precision="bf16",
-            gradient_accumulation_plugin=GradientAccumulationPlugin(
-                num_steps=K, fused=fused
-            ),
-            telemetry=True,
-        )
-        params = acc.prepare(
-            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))[
-                "params"
-            ]
-        )
-        n_params = count_params(params)
-        opt = acc.prepare(optax.adamw(3e-4))
-        carry = acc.init_carry(params, opt)
-        step = acc.unified_step(CausalLM.loss_fn(model), max_grad_norm=1.0)
-        ids = np.random.default_rng(0).integers(
-            0, cfg.vocab_size, (batch_size, seq)
-        ).astype(np.int32)
-        micro = {"input_ids": jnp.asarray(ids)}
-        batch = (
-            {"input_ids": jnp.asarray(np.stack([ids] * K))} if fused else micro
-        )
-        calls_per_opt_step = 1 if fused else K
-        for _ in range(warmup * calls_per_opt_step):
-            carry, metrics = step(carry, batch)
-        np.asarray(metrics["loss"])
-        t0 = time.perf_counter()
-        for _ in range(iters * calls_per_opt_step):
-            carry, metrics = step(carry, batch)
-        np.asarray(metrics["loss"])
-        dt = time.perf_counter() - t0
-        recs = [
-            r for r in acc.telemetry.records if r.get("kind") == "step"
-        ]
-        out[mode] = {
-            "opt_step_s": dt / iters,
-            "dispatches_per_opt_step": recs[-1]["dispatches_per_opt_step"],
-            "microbatches_per_record": recs[-1]["microbatches"],
-            "opt_steps_timed": iters,
-        }
-
-    fused_s = out["fused"]["opt_step_s"]
-    unfused_s = out["unfused"]["opt_step_s"]
-    return {
-        "metric": "accum_fused_opt_step_seconds",
-        "value": round(fused_s, 4),
-        "unit": "s",
-        "vs_baseline": round(unfused_s / fused_s, 3) if fused_s > 0 else None,
-        "extra": {
-            "accum_steps": K,
-            "fused": {k: round(v, 4) if isinstance(v, float) else v
-                      for k, v in out["fused"].items()},
-            "unfused": {k: round(v, 4) if isinstance(v, float) else v
-                        for k, v in out["unfused"].items()},
-            "params": n_params,
-            "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
-            "batch": batch_size, "seq": seq,
-        },
-    }
-
-
-def _run_decode(cfg, batch_size: int, prompt_len: int, new_tokens: int,
-                reps: int):
-    """Autoregressive generation benchmark -> (s/token, n_params, load_s).
-
-    Params are random-initialized DIRECTLY in bf16 on device (a standard
-    fp32 init of a ~5.5B model would not fit 16G); decode quality is
-    irrelevant to throughput — the per-token cost is reading the resident
-    weights once per step (memory-bound), which random weights measure
-    exactly.
-
-    Load time is measured by the separate ``decode_load`` helper variant
-    (folded into this line's extra as ``load_s``) so a slow or failed
-    load can never cost the decode headline.
-    """
-    import numpy as np
-
-    from accelerate_tpu.models import CausalLM, count_params
-    from accelerate_tpu.models.generation import make_generate_fn
-    from accelerate_tpu.parallel.sharding import unbox_params
-
-    _reset_state()
-    model = CausalLM(cfg)
-    abstract = unbox_params(
-        jax.eval_shape(
-            lambda: model.init(
-                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
-            )
-        )
-    )["params"]
-    leaves, treedef = jax.tree_util.tree_flatten(abstract)
-    keys = jax.random.split(jax.random.PRNGKey(0), len(leaves))
-
-    @jax.jit
-    def init_bf16():
-        return jax.tree_util.tree_unflatten(treedef, [
-            jax.random.normal(k, l.shape, jnp.bfloat16)
-            * (0.02 if l.ndim > 1 else 1.0)
-            for k, l in zip(keys, leaves)
-        ])
-
-    params = init_bf16()
-    n_params = count_params(params)
-    gen = make_generate_fn(model, max_new_tokens=new_tokens)
-    ids = jnp.asarray(
-        np.random.default_rng(0).integers(
-            0, cfg.vocab_size, (batch_size, prompt_len)
-        ),
-        jnp.int32,
-    )
-    out = gen(params, ids)
-    np.asarray(out[:, -1])  # full sync (compile + warmup)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = gen(params, ids)
-        np.asarray(out[:, -1])
-    dt = time.perf_counter() - t0
-    return dt / (reps * new_tokens), n_params
-
-
-def _run_decode_load(cfg):
-    """Checkpoint-open -> device-resident seconds for the decode model
-    (VERDICT r4 missing #4: the reference's headline table couples load
-    seconds with s/token — GPT-J 8.7 s, benchmarks/README.md:31).
-
-    The sharded bf16 safetensors checkpoint is synthesized HOST-side
-    (same shapes the decode variant serves; writing from device would pay
-    an 11 GiB device->host pull that measures nothing). The timed section
-    is the real serving cold path users run: streamed
-    ``load_checkpoint_and_dispatch`` from disk to device-resident.
-    On this rig the chip is axon-tunneled at ~0.03 GiB/s each way, so
-    device residency is link-bound, not framework-bound — the
-    disk->host streaming time (the framework's own work) and the
-    host->device push are reported separately so the number stays
-    interpretable against the reference's local-PCIe 8.7 s.
-    """
-    import shutil
-    import tempfile
-
-    import ml_dtypes
-    import numpy as np
-
-    from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
-    from accelerate_tpu.checkpointing import save_model_weights
-    from accelerate_tpu.models import CausalLM, count_params
-    from accelerate_tpu.parallel.sharding import unbox_params
-
-    _reset_state()
-    model = CausalLM(cfg)
-    abstract = unbox_params(
-        jax.eval_shape(
-            lambda: model.init(
-                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
-            )
-        )
-    )["params"]
-    rng = np.random.default_rng(0)
-    host = jax.tree.map(
-        lambda l: rng.standard_normal(l.shape, np.float32)
-        .astype(ml_dtypes.bfloat16),
-        abstract,
-    )
-    n_params = count_params(host)
-    nbytes = sum(l.nbytes for l in jax.tree_util.tree_leaves(host))
-    ckpt_dir = tempfile.mkdtemp(prefix="bench_decode_ckpt_")
-    try:
-        save_model_weights(host, ckpt_dir, max_shard_size="2GB")
-        del host
-        abstract_bf16 = jax.tree.map(
-            lambda l: jax.ShapeDtypeStruct(l.shape, jnp.bfloat16), abstract
-        )
-        from accelerate_tpu.big_modeling import _lazy_checkpoint_reader
-        from accelerate_tpu.checkpointing import _path_str
-
-        # attribution leg: the framework's own streaming work —
-        # checkpoint-open + assemble every tensor host-side, no jax
-        # placement (pure disk + numpy)
-        read = _lazy_checkpoint_reader(ckpt_dir)
-        flat, _ = jax.tree_util.tree_flatten_with_path(abstract_bf16)
-        t0 = time.perf_counter()
-        acc = 0
-        for path, _tmpl in flat:
-            acc += read(_path_str(path)).nbytes
-        disk_to_host_s = time.perf_counter() - t0
-        assert acc == nbytes
-
-        # the serving cold path users run: checkpoint-open ->
-        # device-resident in one streamed call (peak host = one leaf)
-        t1 = time.perf_counter()
-        params = load_checkpoint_and_dispatch(
-            abstract_bf16, ckpt_dir, device_map={"": 0},
-        )
-        np.asarray(jax.tree_util.tree_leaves(params)[-1].ravel()[:1])
-        load_s = time.perf_counter() - t1
-        return {
-            "metric": "checkpoint_load_seconds",
-            "value": round(load_s, 2),
-            "unit": "s",
-            # reference pairs 8.7 s load with its decode headline
-            "vs_baseline": round(8.7 / load_s, 4),
-            "extra": {
-                "disk_to_host_s": round(disk_to_host_s, 2),
-                "host_to_device_s": round(load_s - disk_to_host_s, 2),
-                "gib": round(nbytes / 2**30, 2),
-                "params": n_params,
-                "load_ref_s": 8.7,
-                "note": "host->device rides the axon tunnel "
-                "(~0.03 GiB/s measured) — link-bound, not framework-bound; "
-                "disk_to_host_s is the framework's own streaming time",
-            },
-        }
-    finally:
-        shutil.rmtree(ckpt_dir, ignore_errors=True)
-
-
-def _compile_probe():
-    """Arm the process-wide CompileMonitor; the returned closure yields
-    the compile cost accrued since (JSON-ready). ``compile_time_s`` is
-    XLA backend-compile seconds — it does NOT accrue on a persistent-
-    cache hit, so warm-cache runs show the cache working: hits > 0,
-    compile_time_s ~ 0, and the headline step time is pure steady-state."""
-    from accelerate_tpu.compilation import (
-        get_compile_monitor,
-        persistent_cache_dir,
-    )
-
-    mon = get_compile_monitor()
-    before = mon.snapshot()
-
-    def done() -> dict:
-        delta = mon.delta(before)
-        return {
-            "compile_time_s": round(
-                float(delta.get("compile_time_s", 0.0)), 3
-            ),
-            "persistent_cache_hits": int(
-                delta.get("persistent_cache_hits", 0)
-            ),
-            "persistent_cache_misses": int(
-                delta.get("persistent_cache_misses", 0)
-            ),
-            "compile_cache_dir": persistent_cache_dir(),
-        }
-
-    return done
-
-
-def _goodput_fields(wall_s, productive_s, compile_s=0.0,
-                    checkpoint_s=0.0) -> dict:
-    """Variant-level goodput line: fold the quantities the bench already
-    measures through the production GoodputAccounting (synthetic `now`
-    injection — live per-step telemetry would add the per-step
-    block_until_ready the aggregate-timing design deliberately avoids).
-    `idle` is the unaccounted remainder: model init, prepare, warmup
-    steps, teardown."""
-    from accelerate_tpu.diagnostics.goodput import (
-        BADPUT_BUCKETS,
-        GoodputAccounting,
-    )
-
-    wall_s = max(float(wall_s), 1e-9)
-    g = GoodputAccounting(window_s=wall_s, now=0.0)
-    g.add("productive", float(productive_s), now=wall_s)
-    g.add("compile", float(compile_s), now=wall_s)
-    g.add("checkpoint", float(checkpoint_s), now=wall_s)
-    snap = g.snapshot(now=wall_s)
-    return {
-        "goodput_pct": round(snap["goodput_pct"], 1),
-        **{
-            f"badput_{b}_s": round(snap["buckets"][b], 3)
-            for b in BADPUT_BUCKETS
-        },
-    }
-
-
-def _result_line(name, cfg, batch_size, seq, iters, warmup,
-                 optimizer="adamw") -> dict:
-    # compile attribution covers the WHOLE variant (prepare + warmup +
-    # timed loop) — any jit in the process accrues, so the emitted line
-    # separates total compile cost from the steady-state measurement
-    wall_t0 = time.perf_counter()
-    probe = _compile_probe()
-    checkpoint_s = 0.0
-    if name == "decode_load":
-        rec = _run_decode_load(cfg)
-        rec["extra"].update(probe())
-        # a pure load/restore variant trains nothing: goodput is honestly 0
-        productive_s = 0.0
-    elif name == "ckpt":
-        rec = _run_ckpt(cfg, batch_size, seq, iters, warmup)
-        rec["extra"].update(probe())
-        extra = rec["extra"]
-        productive_s = sum(
-            extra[m]["quiet_step_s"] * iters for m in ("sync", "async")
-        )
-        checkpoint_s = sum(
-            extra[m]["blocked_s"] * extra[m]["saves"] for m in ("sync", "async")
-        )
-    elif name == "accum":
-        rec = _run_accum(cfg, batch_size, seq, iters, warmup)
-        rec["extra"].update(probe())
-        extra = rec["extra"]
-        productive_s = sum(
-            extra[m]["opt_step_s"] * extra[m]["opt_steps_timed"]
-            for m in ("fused", "unfused")
-        )
-    elif name == "decode":
-        prompt_len, new_tokens, reps = seq, iters, warmup
-        s_token, n_params = _run_decode(
-            cfg, batch_size, prompt_len, new_tokens, reps
-        )
-        productive_s = s_token * new_tokens * reps
-        rec = {
-            "metric": "generate_seconds_per_token",
-            "value": round(s_token, 4),
-            "unit": "s/token",
-            # reference headline: GPT-J-6B fp16 at 0.05 s/token
-            # (benchmarks/README.md:31); >= 1 beats it
-            "vs_baseline": round(0.05 / s_token, 3),
-            "extra": {
-                "params": n_params,
-                "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
-                "batch": batch_size, "prompt_len": prompt_len,
-                "new_tokens": new_tokens,
-                **probe(),
-            },
-        }
-    else:
-        tps, step_time, n_params = _run(
-            cfg, batch_size, seq, iters, warmup, optimizer
-        )
-        mfu = _mfu(cfg, n_params, seq, tps)
-        productive_s = step_time * iters
-        rec = {
-            "metric": f"train_tokens_per_sec_per_chip_{name}"
-            if name != "dense" else "train_tokens_per_sec_per_chip",
-            "value": round(tps, 1),
-            "unit": "tokens/s/chip",
-            "vs_baseline": round(mfu / 0.60, 4),
-            "extra": {
-                "step_time_s": round(step_time, 4),
-                "mfu": round(mfu, 4),
-                "params": n_params,
-                "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
-                "batch": batch_size, "seq": seq,
-                **probe(),
-            },
-        }
-    rec["extra"].update(
-        _goodput_fields(
-            wall_s=time.perf_counter() - wall_t0,
-            productive_s=productive_s,
-            compile_s=rec["extra"].get("compile_time_s", 0.0),
-            checkpoint_s=checkpoint_s,
-        )
-    )
-    return rec
-
-
-def _detect_backend() -> str:
-    """Backend without initializing it in THIS process: on hosts where the
-    TPU is an exclusively-locked local device, a parent that touches it
-    would starve the per-variant child processes."""
-    import subprocess
-
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True, timeout=300,
-        )
-        return probe.stdout.strip().splitlines()[-1]
-    except Exception:  # noqa: BLE001 — fall back to in-process detection
-        return jax.default_backend()
-
-
-def main():
-    only = sys.argv[1] if len(sys.argv) > 1 else None
-    on_tpu = (
-        jax.default_backend() == "tpu" if only else _detect_backend() == "tpu"
-    )
-    configs = _configs(on_tpu)
-    if only is not None and only not in configs:
-        print(f"unknown bench variant {only!r}; choose from {sorted(configs)}",
-              file=sys.stderr)
-        return 2
-    if only:
-        # child process: join the cache dir the parent exported (covers
-        # the decode/generation variants too, which never build an
-        # Accelerator — the training path would also pick the env var up
-        # through CompilePlugin)
-        from accelerate_tpu.compilation import activate_persistent_cache
-        from accelerate_tpu.utils.dataclasses import CompilePlugin
-
-        activate_persistent_cache(CompilePlugin())  # no-op when env unset
-        print(json.dumps(_result_line(only, *configs[only])), flush=True)
-        return 0
-    if not on_tpu:  # CPU smoke: just the tiny dense line, in-process
-        print(json.dumps(_result_line("dense", *configs["dense"])), flush=True)
-        return 0
-
-    # One subprocess per variant: a fresh process releases all HBM between
-    # configs (in-process, buffers + jit caches from earlier variants leave
-    # too little HBM for the 916M dense headline). Collect all lines, fold
-    # the xla delta into the longseq line, print the dense HEADLINE LAST
-    # (the driver parses the final line).
-    import os
-    import subprocess
-    import tempfile
-
-    # One persistent XLA cache dir shared by every variant child (they
-    # inherit the env; CompilePlugin reads it). The variants share model
-    # shapes across retries and the longseq/longseq4k pairs, so repeated
-    # programs deserialize instead of recompiling — the rc=124 driver
-    # timeouts that erased BENCH_r05 were mostly serial compile time.
-    # Children run SERIALLY, so sharing is safe (concurrent writers to
-    # one cache dir deadlocked in a past parallel-pytest measurement —
-    # do not copy this pattern into parallel workers).
-    os.environ.setdefault(
-        "ACCELERATE_TPU_COMPILE_CACHE",
-        os.path.join(tempfile.gettempdir(), "accelerate_tpu_bench_xla_cache"),
-    )
-
-    def _implausible(rec: dict) -> bool:
-        # the tunneled chip occasionally degrades ~20x right after long
-        # multi-process sessions (observed: dense at 1.2k tok/s vs the
-        # usual 26k, recovering by itself a minute later) — a train
-        # variant reporting under 10% MFU on real hardware is that
-        # transient, not a real measurement
-        return (
-            rec["unit"] == "tokens/s/chip"
-            and rec["extra"].get("mfu", 1.0) < 0.10
-        )
-
-    def _oom_line(err: str):
-        return next(
-            (l.strip() for l in err.splitlines()
-             if "RESOURCE_EXHAUSTED" in l or "Ran out of memory" in l),
-            None,
-        )
-
-    results: dict[str, dict] = {}
-    errors: dict[str, str] = {}
-    for name in configs:
-        rec = None
-        first_rec = None
-        err = None
-        # decode_load moves ~11 GiB across the ~0.03 GiB/s axon tunnel —
-        # genuinely slow, not hung
-        budget_s = 1800 if name == "decode_load" else 900
-        for attempt in range(2):
-            try:
-                proc = subprocess.run(
-                    [sys.executable, __file__, name], text=True,
-                    capture_output=True,
-                    timeout=budget_s,
-                )
-            except subprocess.TimeoutExpired:
-                # discard any implausible first-attempt record too — never
-                # publish a known-bad measurement alongside an error. A
-                # timeout is NOT retried: another budget_s would risk the
-                # driver's wall-clock window.
-                rec = None
-                err = f"timeout after {budget_s}s"
-                break
-            line = next(
-                (l for l in proc.stdout.splitlines() if l.startswith("{")), None
-            )
-            if proc.returncode != 0 or line is None:
-                # CRASH path. Round 3 lost its dense headline here: the
-                # crash was a transient tunnel error but only implausibly-
-                # slow *successes* were retried. Retry crashes once after a
-                # 60s settle — except deterministic OOMs, where a retry
-                # just re-pays the compile (and for the longseq_xla
-                # variants OOM is the expected, informative outcome).
-                rec = None
-                err = (proc.stderr or "no output").strip()
-                oom = _oom_line(err)
-                err = oom or err[-300:]
-                if attempt == 0 and oom is None:
-                    print(
-                        f"variant {name} crashed "
-                        f"(rc={proc.returncode}); retrying after a 60s "
-                        "settle",
-                        file=sys.stderr,
-                    )
-                    time.sleep(60)
-                    continue
-                break
-            rec = json.loads(line)
-            err = None
-            if _implausible(rec) and attempt == 0:
-                print(
-                    f"variant {name} implausibly slow "
-                    f"({rec['value']} {rec['unit']}); retrying after "
-                    "a 60s settle",
-                    file=sys.stderr,
-                )
-                first_rec = rec
-                time.sleep(60)
-                continue
-            break
-        if rec is not None:
-            if first_rec is not None:
-                # keep the better of the two attempts: a genuinely-slow
-                # variant measures the same twice (number stands), the
-                # degraded-chip transient recovers on the retry
-                if first_rec["value"] > rec["value"]:
-                    rec = first_rec
-                rec["extra"]["retried"] = True
-            results[name] = rec
-            # Emit the record the moment the variant lands, flushed, so a
-            # driver wall-clock kill cannot discard completed measurements
-            # (BENCH_r05 was rc=124 with an empty tail). The consolidated
-            # block below re-prints the FINAL (folded) records with dense
-            # last — consumers of the whole stream skip provisional lines,
-            # the parse-the-last-line driver never sees them on a clean run.
-            print(json.dumps({**rec, "provisional": True}), flush=True)
-        else:
-            errors[name] = err or "no output"
-            print(
-                f"bench variant {name} failed (provisional): "
-                f"{errors[name][:160]}",
-                file=sys.stderr, flush=True,
-            )
-    # fold the load-time helper into the decode line (never the reverse:
-    # a failed load leaves the decode headline intact with load_s null)
-    if "decode" in results:
-        extra = results["decode"]["extra"]
-        if "decode_load" in results:
-            rec_l = results.pop("decode_load")
-            extra["load_s"] = rec_l["value"]
-            extra["load_disk_to_host_s"] = rec_l["extra"]["disk_to_host_s"]
-            extra["load_host_to_device_s"] = rec_l["extra"]["host_to_device_s"]
-            extra["load_gib"] = rec_l["extra"]["gib"]
-            extra["load_ref_s"] = 8.7
-            extra["load_note"] = rec_l["extra"]["note"]
-        else:
-            extra["load_s"] = None
-            extra["load_error"] = errors.pop("decode_load", "unknown")[:160]
-
-    helpers = ("longseq_xla", "longseq4k", "longseq_xla4k")
-    if "longseq" in results:
-        extra = results["longseq"]["extra"]
-        if "longseq_xla" in results:
-            xla_step = results["longseq_xla"]["extra"]["step_time_s"]
-            extra["xla_step_time_s"] = xla_step
-            extra["flash_speedup_vs_xla"] = round(
-                xla_step / extra["step_time_s"], 3
-            )
-        else:
-            # numeric fields stay numeric (None) for machine consumers;
-            # the error text gets its own key
-            extra["xla_step_time_s"] = None
-            extra["flash_speedup_vs_xla"] = None
-            extra["xla_error"] = errors.pop("longseq_xla", "unknown")[:160]
-        # the S=4096 pair, where dense attention fits 16G: always record
-        # whichever step times landed (even a lone one — never discard a
-        # valid measurement), and let the pair supply the headline speedup
-        # when the S=8192 dense point failed (null in rounds 2 and 3)
-        if "longseq4k" in results:
-            extra["flash_step_s_s4096"] = (
-                results["longseq4k"]["extra"]["step_time_s"]
-            )
-        if "longseq_xla4k" in results:
-            extra["xla_step_s_s4096"] = (
-                results["longseq_xla4k"]["extra"]["step_time_s"]
-            )
-        if "longseq4k" in results and "longseq_xla4k" in results:
-            flash4k = results["longseq4k"]["extra"]["step_time_s"]
-            xla4k = results["longseq_xla4k"]["extra"]["step_time_s"]
-            if extra["flash_speedup_vs_xla"] is None:
-                extra["flash_speedup_vs_xla"] = round(xla4k / flash4k, 3)
-                extra["speedup_measured_at_seq"] = 4096
-                extra["speedup_optimizer"] = "sgd"
-        for name in helpers:
-            results.pop(name, None)
-    # when longseq itself failed, measured helper records stay in
-    # ``results`` and print as their own lines below — a valid measurement
-    # is never silently discarded
-    for name in [n for n in results if n != "dense"] + ["dense"]:
-        if name in results:
-            print(json.dumps(results[name]), flush=True)
-    for name, err in errors.items():
-        qualifier = (
-            " (expected on 16G chips — the dense-attention comparison point)"
-            if name == "longseq_xla" else ""
-        )
-        print(f"bench variant {name} failed{qualifier}: {err}", file=sys.stderr)
-    return 0 if "dense" in results else 1
-
+from accelerate_tpu.benchmarks.cli import main
 
 if __name__ == "__main__":
     sys.exit(main())
