@@ -29,10 +29,11 @@ FAST_FILES = \
   tests/test_ring_attention.py tests/test_seq2seq.py \
   tests/test_telemetry.py tests/test_compilation.py \
   tests/test_checkpoint_async.py tests/test_fused_accum.py \
-  tests/test_diagnostics.py tests/test_benchmarks.py
+  tests/test_diagnostics.py tests/test_benchmarks.py \
+  tests/test_serving.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
-  diag-smoke bench-fast-smoke
+  diag-smoke bench-fast-smoke serve-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -82,6 +83,17 @@ bench-fast-smoke:
 	$(PYTEST) -q \
 	  tests/test_benchmarks.py::test_bench_fast_deadline_end_to_end \
 	  tests/test_benchmarks.py::test_sigkilled_child_leaves_recoverable_partial
+
+# serving acceptance on CPU: paged-engine greedy decode == the dense
+# generate path token-for-token, EOS-freed slots refill mid-flight with
+# every request completing and no leaked blocks, and the serve bench
+# variant reports continuous-batched vs fixed-batch aggregate tokens/s
+# (vs_baseline >= 2 is the acceptance bar) with zero decode retraces
+serve-smoke:
+	$(PYTEST) -q \
+	  tests/test_serving.py::test_paged_generate_matches_dense_generate \
+	  tests/test_serving.py::test_eos_slot_refill_completes_all_requests
+	python bench.py serve
 
 # diagnostics end-to-end on CPU: a tiny train loop with an injected slow
 # step and an injected NaN gradient runs with the flight recorder on,

@@ -38,6 +38,7 @@ from .local_sgd import LocalSGD
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler
+from .serving import ServingEngine, TokenEvent
 from .state import AcceleratorState, GradientState, PartialState
 from .telemetry import (
     HeartbeatMonitor,
@@ -114,4 +115,6 @@ __all__ = [
     "list_dumps",
     "build_report",
     "format_report",
+    "ServingEngine",
+    "TokenEvent",
 ]

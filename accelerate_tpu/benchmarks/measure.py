@@ -550,6 +550,182 @@ def _run_decode_load(cfg, partial: Optional[PartialWriter] = None):
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
+def _run_serve(cfg, max_slots: int, block_size: int, n_requests: int,
+               seed: int, partial: Optional[PartialWriter] = None):
+    """Aggregate serving throughput: continuous-batched paged decode
+    (ServingEngine) vs sequential fixed-batch ``generate`` on the SAME
+    long-tailed request trace (mostly short answers, a fat tail of long
+    ones — the production shape where run-to-completion batching stalls
+    a whole chunk on its longest member). Both paths run the full trace
+    once as warmup (all prefill buckets + the decode step compile), then
+    once timed; ``vs_baseline`` is engine/baseline aggregate USEFUL
+    tokens per second (each request's own new tokens — the padding
+    tokens the fixed batch generates for already-satisfied rows count
+    for nothing). The acceptance bar is >= 2.
+
+    Also reports the analytic HBM-bytes-per-generated-token of the KV
+    cache under each scheme: dense reserves ``max_seq_len`` positions
+    per request; paged reserves ``ceil((P+N)/block_size)`` blocks.
+    """
+    from accelerate_tpu.models import CausalLM, count_params
+    from accelerate_tpu.models.generation import make_generate_fn
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving import ServingEngine
+
+    partial = partial or _noop_writer("serve")
+    _reset_state()
+    model = CausalLM(cfg)
+    # random bf16 params directly on device (same rationale as decode:
+    # throughput reads the resident weights; quality is irrelevant)
+    abstract = unbox_params(
+        jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )
+        )
+    )["params"]
+    leaves, treedef = jax.tree_util.tree_flatten(abstract)
+    keys = jax.random.split(jax.random.PRNGKey(0), len(leaves))
+
+    @jax.jit
+    def init_bf16():
+        return jax.tree_util.tree_unflatten(treedef, [
+            jax.random.normal(k, l.shape, jnp.bfloat16)
+            * (0.02 if l.ndim > 1 else 1.0)
+            for k, l in zip(keys, leaves)
+        ])
+
+    params = init_bf16()
+    n_params = count_params(params)
+
+    # long-tailed trace: ~3/4 short completions, ~1/4 long ones, mixed
+    # prompt lengths — every chunk of a fixed batch almost surely holds
+    # one long request that the short ones must wait out
+    rng = np.random.default_rng(seed)
+    max_prompt = max(8, min(cfg.max_seq_len // 4, 64))
+    long_new = min(64, cfg.max_seq_len - max_prompt)
+    requests = []
+    for i in range(n_requests):
+        p = int(rng.integers(4, max_prompt + 1))
+        if rng.random() < 0.25:
+            n = int(rng.integers(long_new // 2, long_new + 1))
+        else:
+            n = int(rng.integers(4, 9))
+        prompt = rng.integers(0, cfg.vocab_size, p).astype(np.int32)
+        requests.append((prompt, n))
+    useful_tokens = sum(n for _, n in requests)
+    prompt_tokens = sum(len(p) for p, _ in requests)
+
+    engine = ServingEngine(
+        model, params, max_slots=max_slots, block_size=block_size
+    )
+
+    def run_engine():
+        for prompt, n in requests:
+            engine.add_request(prompt.tolist(), max_new_tokens=n)
+        for _ in engine.stream():
+            pass
+
+    run_engine()  # warmup: compiles every prefill bucket + the decode step
+    warm_traces = engine.trace_counts()
+    partial.update(phase="engine_warm", iters_measured=0)
+    t0 = time.perf_counter()
+    run_engine()
+    engine_s = time.perf_counter() - t0
+    engine_tps = useful_tokens / engine_s
+    decode_retraces = engine.trace_counts()["decode"] - warm_traces["decode"]
+    partial.update(
+        phase="engine_done", iters_measured=n_requests,
+        metric="serve_tokens_per_sec",
+        value=round(engine_tps, 1), unit="tokens/s",
+        extra={"engine_wall_s": round(engine_s, 3),
+               "useful_new_tokens": useful_tokens,
+               "device": _device_kind()},
+    )
+
+    # baseline: run-to-completion fixed batches of max_slots, each padded
+    # to its chunk's max prompt length and decoded to its chunk's max
+    # new-token budget (what a generate() serving loop actually does);
+    # short chunks are padded back up to max_slots — a fixed batch cannot
+    # shrink without retracing
+    chunks = [
+        requests[i:i + max_slots] for i in range(0, n_requests, max_slots)
+    ]
+    fns: dict = {}
+
+    def run_baseline():
+        for chunk in chunks:
+            rows = list(chunk) + [chunk[0]] * (max_slots - len(chunk))
+            p_max = max(len(p) for p, _ in rows)
+            n_max = max(n for _, n in rows)
+            fn = fns.setdefault(
+                n_max, make_generate_fn(model, max_new_tokens=n_max)
+            )
+            batch = np.zeros((max_slots, p_max), np.int32)
+            for j, (p, _) in enumerate(rows):
+                batch[j, :len(p)] = p
+            out = fn(params, jnp.asarray(batch))
+            np.asarray(out[:, -1])
+
+    run_baseline()  # warmup: same chunk shapes as the timed pass
+    partial.update(phase="baseline_warm", iters_measured=n_requests)
+    t1 = time.perf_counter()
+    run_baseline()
+    baseline_s = time.perf_counter() - t1
+    baseline_tps = useful_tokens / baseline_s
+
+    # analytic KV-cache HBM traffic per useful token (bf16 K+V)
+    itemsize = 2
+    bytes_per_pos = (
+        cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * 2 * itemsize
+    )
+    dense_kv = n_requests * cfg.max_seq_len * bytes_per_pos
+    paged_kv = sum(
+        -(-(len(p) + n) // block_size) * block_size for p, n in requests
+    ) * bytes_per_pos
+    summary = engine.summary()
+    return {
+        "metric": "serve_tokens_per_sec",
+        "value": round(engine_tps, 1),
+        "unit": "tokens/s",
+        # acceptance bar: continuous-batched paged decode >= 2x the
+        # sequential fixed-batch path on this trace
+        "vs_baseline": round(engine_tps / baseline_tps, 3),
+        "extra": {
+            "baseline_tokens_per_s": round(baseline_tps, 1),
+            "engine_wall_s": round(engine_s, 3),
+            "baseline_wall_s": round(baseline_s, 3),
+            "requests": n_requests,
+            "max_slots": max_slots,
+            "block_size": block_size,
+            "useful_new_tokens": useful_tokens,
+            "prompt_tokens": prompt_tokens,
+            "decode_retraces_after_warmup": decode_retraces,
+            "prefill_traces": engine.trace_counts()["prefill"],
+            **{
+                k: round(v, 4) if v is not None else None
+                for k, v in (
+                    ("ttft_p50_s", summary.get("ttft_s_p50")),
+                    ("ttft_p95_s", summary.get("ttft_s_p95")),
+                    ("decode_tokens_per_s_p50",
+                     summary.get("decode_tokens_per_s_p50")),
+                    ("decode_tokens_per_s_p95",
+                     summary.get("decode_tokens_per_s_p95")),
+                )
+            },
+            "hbm_kv_bytes_per_token_paged": round(
+                paged_kv / useful_tokens, 1
+            ),
+            "hbm_kv_bytes_per_token_dense": round(
+                dense_kv / useful_tokens, 1
+            ),
+            "kv_bytes_saved_vs_dense": round(1 - paged_kv / dense_kv, 3),
+            "params": n_params,
+            "device": _device_kind(),
+        },
+    }
+
+
 def _run_overhead(cfg, batch_size: int, seq: int, iters: int, warmup: int,
                   partial: Optional[PartialWriter] = None):
     """Telemetry+diagnostics ON-vs-OFF A/B: the harness proving ITSELF
@@ -793,6 +969,17 @@ def result_line(variant, partial: Optional[PartialWriter] = None) -> dict:
             rec["extra"]["median_step_on_s"]
             + rec["extra"]["median_step_off_s"]
         ) * iters
+    elif kind == "serve":
+        max_slots, block_size, n_requests, seed = batch_size, seq, iters, warmup
+        rec = _run_serve(
+            cfg, max_slots, block_size, n_requests, seed, partial=partial
+        )
+        rec["extra"].update(probe())
+        # both the engine pass and the fixed-batch baseline are real
+        # measured generation
+        productive_s = (
+            rec["extra"]["engine_wall_s"] + rec["extra"]["baseline_wall_s"]
+        )
     elif kind == "decode":
         prompt_len, new_tokens, reps = seq, iters, warmup
         s_token, n_params = _run_decode(

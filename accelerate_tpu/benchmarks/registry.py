@@ -25,7 +25,7 @@ ENV_ITERS = "ACCELERATE_TPU_BENCH_ITERS"  # test/debug: stretch train loops
 @dataclass(frozen=True)
 class Variant:
     name: str
-    kind: str  # "train" | "ckpt" | "accum" | "decode" | "decode_load" | "overhead"
+    kind: str  # "train" | "ckpt" | "accum" | "decode" | "decode_load" | "serve" | "overhead"
     priority: int
     group: str
     args: tuple = field(default_factory=tuple)
@@ -137,6 +137,12 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
             # the 2% bar instead of being amplified by a tiny step
             _variant("overhead", "overhead", 2, "overhead",
                      (tiny, 8, 256, 20, 3), fast=True, default_estimate_s=30),
+            # continuous-batched paged decode vs sequential fixed-batch
+            # generate; NOT in --fast (it compiles every prefill bucket
+            # plus two decode paths — too heavy for the 120s deadline).
+            # args: (cfg, max_slots, block_size, n_requests, seed)
+            _variant("serve", "serve", 3, "serve", (tiny, 4, 8, 16, 0),
+                     default_estimate_s=60),
             _variant("ckpt", "ckpt", 3, "ckpt", (tiny, 4, 64, 8, 2),
                      fast=True, default_estimate_s=15),
         ])
@@ -255,6 +261,11 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
                  fast=True, default_estimate_s=500),
         _variant("decode", "decode", 2, "decode", (decode, 1, 128, 64, 1),
                  default_estimate_s=600),  # B, prompt, new_tokens, reps
+        # serving line on the same ~5.5B decode model (shares its child
+        # process and resident weights-compile budget); args:
+        # (cfg, max_slots, block_size, n_requests, seed)
+        _variant("serve", "serve", 3, "decode", (decode, 4, 16, 8, 0),
+                 default_estimate_s=900),
         _variant("moe", "train", 3, "moe", (moe, 16, 1024, 20, 3),
                  default_estimate_s=600),
         _variant("longseq", "train", 3, "longseq", (longseq, 1, 8192, 8, 2),

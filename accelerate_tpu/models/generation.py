@@ -20,11 +20,10 @@ import jax.numpy as jnp
 from .transformer import CausalLM
 
 
-def _sample_logits(logits, key, temperature, top_k, top_p):
-    """(B, V) logits -> (B,) token ids."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
-    logits = logits.astype(jnp.float32) / temperature
+def _filter_logits(logits, top_k, top_p):
+    """(B, V) fp32 logits -> same, with everything outside the top-k /
+    nucleus set at -inf. Shared by batch sampling here and the per-slot
+    serving sampler (:mod:`accelerate_tpu.serving.sampling`)."""
     if top_k is not None and top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -39,6 +38,14 @@ def _sample_logits(logits, key, temperature, top_k, top_p):
             jnp.where(include, sorted_logits, jnp.inf), axis=-1, keepdims=True
         )
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
+def _sample_logits(logits, key, temperature, top_k, top_p):
+    """(B, V) logits -> (B,) token ids."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = _filter_logits(logits.astype(jnp.float32) / temperature, top_k, top_p)
     return jax.random.categorical(key, logits, axis=-1)
 
 
@@ -117,19 +124,106 @@ def generate(
     return jnp.concatenate([input_ids, new_tokens], axis=1)
 
 
+def _prompt_chunks(prompt_len: int) -> list[int]:
+    """Descending power-of-two decomposition of a prompt length (13 ->
+    [8, 4, 1]): the chunk widths every prompt can be prefilled with."""
+    chunks, width = [], 1 << (max(prompt_len, 1).bit_length() - 1)
+    while prompt_len:
+        if width <= prompt_len:
+            chunks.append(width)
+            prompt_len -= width
+        width >>= 1
+    return chunks
+
+
 def make_generate_fn(
     model: CausalLM,
     max_new_tokens: int = 32,
-    **sample_kwargs,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_token_id: Optional[int] = None,
 ):
-    """A jitted generate closure: ``fn(params, input_ids, key) -> ids``.
-    Compile once, call per batch (static prompt length)."""
+    """A compiled generate closure: ``fn(params, input_ids, key) -> ids``.
+
+    The old closure jitted the WHOLE generate, so every distinct prompt
+    length retraced prefill + decode scan — a serving workload with mixed
+    prompts recompiled per length (the retrace trap). Here prefill runs
+    as descending power-of-two CHUNKS through one shared jitted apply
+    (13 tokens -> chunks of 8, 4, 1 written at their true cache offsets —
+    the dense decode branch anchors masks at the global position, so the
+    math is EXACT, not bucket-padded), and the decode scan is jitted once
+    per batch size. Across any mix of prompt lengths at most
+    ``log2(max_seq_len)`` prefill programs ever compile.
+
+    ``fn.trace_counts()`` exposes ``{"prefill": n, "decode": m}`` (Python
+    trace-time counters) so tests can assert the bound.
+    """
+    traces = {"prefill": 0, "decode": 0}
 
     @jax.jit
-    def fn(params, input_ids, key=None):
-        return generate(
-            model, params, input_ids, max_new_tokens=max_new_tokens,
-            key=key, **sample_kwargs,
+    def _prefill_chunk(params, cache, chunk):
+        traces["prefill"] += 1
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, chunk, decode=True,
+            mutable=["cache"],
+        )
+        return mutated["cache"], logits[:, -1]
+
+    @jax.jit
+    def _decode(params, cache, last_logits, key):
+        traces["decode"] += 1
+        # sampling order matches generate() exactly: first token from the
+        # caller's key, scan steps split from it — same key math, same
+        # tokens, so the two APIs are interchangeable
+        first = _sample_logits(last_logits, key, temperature, top_k, top_p)
+        done = (
+            (first == eos_token_id)
+            if eos_token_id is not None
+            else jnp.zeros(first.shape, bool)
         )
 
+        def step(carry, _):
+            cache, token, k, done = carry
+            k, sub = jax.random.split(k)
+            logits, mutated = model.apply(
+                {"params": params, "cache": cache}, token[:, None],
+                decode=True, mutable=["cache"],
+            )
+            nxt = _sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+            if eos_token_id is not None:
+                nxt = jnp.where(done, eos_token_id, nxt)
+                done = done | (nxt == eos_token_id)
+            return (mutated["cache"], nxt, k, done), nxt
+
+        if max_new_tokens > 1:
+            _, rest = jax.lax.scan(
+                step, (cache, first, key, done), None,
+                length=max_new_tokens - 1,
+            )
+            return jnp.concatenate([first[:, None], rest.T], axis=1)
+        return first[:, None]
+
+    def fn(params, input_ids, key=None):
+        B, prompt_len = input_ids.shape
+        if prompt_len + max_new_tokens > model.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len ({model.config.max_seq_len})"
+            )
+        key = key if key is not None else jax.random.PRNGKey(0)
+        cache = init_cache(
+            model.init, jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32),
+            decode=True,
+        )
+        offset = 0
+        for width in _prompt_chunks(prompt_len):
+            cache, last = _prefill_chunk(
+                params, cache, input_ids[:, offset:offset + width]
+            )
+            offset += width
+        new_tokens = _decode(params, cache, last, key)
+        return jnp.concatenate([input_ids, new_tokens], axis=1)
+
+    fn.trace_counts = lambda: dict(traces)
     return fn

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 
-from ..ops.attention import dot_product_attention
+from ..ops.attention import dot_product_attention, paged_attention, paged_update
 from .config import TransformerConfig
 
 Dtype = Any
@@ -178,7 +178,7 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, mask=None, kv_lengths=None,
-                 layer_window=None):
+                 paged=None, layer_window=None):
         decode = self.decode
         cfg = self.config
         # static homogeneous band, or the per-layer traced one (Gemma-2)
@@ -211,7 +211,32 @@ class Attention(nn.Module):
         k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
 
-        if decode:
+        use_paged = False
+        if decode and paged is not None:
+            # Paged decode (vLLM block tables, static-shape XLA form): the
+            # K/V pools are cache variables with NO batch dim — every slot
+            # and every prefill call shares ONE pool pytree, routed through
+            # the per-call block tables in ``paged`` (ops/attention.py's
+            # PagedKVState). The has_variable guard keeps the init pass on
+            # the plain path (creation must not write).
+            is_initialized = self.has_variable("cache", "key_pool")
+            key_pool = self.variable(
+                "cache", "key_pool",
+                lambda: jnp.zeros(
+                    (paged.num_blocks, paged.block_size,
+                     cfg.num_kv_heads, cfg.head_dim), k.dtype,
+                ),
+            )
+            value_pool = self.variable(
+                "cache", "value_pool",
+                lambda: jnp.zeros(
+                    (paged.num_blocks, paged.block_size,
+                     cfg.num_kv_heads, cfg.head_dim), v.dtype,
+                ),
+            )
+            use_paged = is_initialized
+            decode = False
+        elif decode:
             # KV-cache decode (flax decode-cache pattern): a fixed-size
             # per-layer cache collection, updated in place at cache_index.
             # Static shapes throughout — XLA-friendly autoregression.
@@ -231,7 +256,24 @@ class Attention(nn.Module):
                 "cache", "cache_index", lambda: jnp.asarray(0, jnp.int32)
             )
             decode = is_initialized
-        if decode:
+        if use_paged:
+            # per-slot positions: slot b's token i sits at global position
+            # cache_len[b] + i (heterogeneous across the batch — the dense
+            # path's single scalar index cannot express a decode batch
+            # whose members are at different depths)
+            positions = paged.cache_len[:, None] + jnp.arange(s)[None, :]
+            q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+            k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+            new_k, new_v = paged_update(
+                key_pool.value, value_pool.value, k, v, paged
+            )
+            key_pool.value = new_k
+            value_pool.value = new_v
+            out = paged_attention(
+                q, new_k, new_v, paged, scale=scale,
+                softcap=cfg.attn_softcap, window=window,
+            )
+        elif decode:
             idx = cache_index.value
             positions = idx + jnp.arange(s)[None, :]  # (1, s) broadcasts over batch
             q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
@@ -457,13 +499,13 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, mask=None, kv_lengths=None,
-                 layer_window=None):
+                 paged=None, layer_window=None):
         from ..parallel.sharding import constrain_activations
 
         cfg = self.config
         attn_out = Attention(cfg, decode=self.decode, name="attn")(
             RMSNorm(cfg, name="attn_norm")(x), positions, mask, kv_lengths,
-            layer_window,
+            paged, layer_window,
         )
         if cfg.post_norms:
             # Gemma-2 block: a norm AFTER each sublayer too (pre + post,
@@ -600,7 +642,8 @@ class CausalLM(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, mask=None, decode=False):
+    def __call__(self, input_ids, positions=None, mask=None, decode=False,
+                 paged=None):
         cfg = self.config
         dtype = _dtype(cfg)
         if positions is None:
@@ -614,10 +657,11 @@ class CausalLM(nn.Module):
         if cfg.embed_scale:  # Gemma scales embeddings by sqrt(hidden)
             x = x * jnp.asarray(np.sqrt(cfg.hidden_size), x.dtype)
         x = constrain_activations(x)
-        # the explicit None fills the block's kv_lengths slot so the
-        # per-layer window array (if any) lands on layer_window
+        # the explicit None fills the block's kv_lengths slot (and paged
+        # fills its own) so the per-layer window array (if any) lands on
+        # layer_window
         x = _apply_layer_stack(
-            cfg, x, positions, mask, None, decode=decode,
+            cfg, x, positions, mask, None, paged, decode=decode,
             per_layer=_layer_windows_array(cfg),
         )
         x = constrain_activations(RMSNorm(cfg, name="final_norm")(x))
@@ -738,8 +782,10 @@ class SequenceClassifier(nn.Module):
                 # (B, S) keep-mask -> (B, 1, 1, S): padded keys invisible
                 attn_mask4d = attention_mask[:, None, None, :] > 0
         x = _make_embed(cfg, dtype)(input_ids)
+        # the explicit None fills the block's paged slot so the per-layer
+        # window array (if any) lands on layer_window
         x = _apply_layer_stack(
-            cfg, x, positions, attn_mask4d, kv_lengths,
+            cfg, x, positions, attn_mask4d, kv_lengths, None,
             per_layer=_layer_windows_array(cfg),
         )
         if is_prefix is not None:

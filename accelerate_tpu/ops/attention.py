@@ -19,8 +19,113 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import flax.struct
 import jax
 import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class PagedKVState:
+    """Per-call view of the paged KV cache (vLLM-style block tables in
+    static-shape XLA form).
+
+    The pools themselves live as flax ``cache`` variables inside the
+    model ((num_blocks, block_size, kv_heads, head_dim) per layer — NO
+    batch dim, so heterogeneous sequence lengths share HBM); this struct
+    carries the per-slot indexing that routes each call into them:
+
+    ``block_table``  (B, max_blocks) int32 — pool indices per slot, in
+                     sequence order: table slot t holds global positions
+                     [t*block_size, (t+1)*block_size). Unused tail
+                     entries point at block 0, the RESERVED garbage
+                     block the host allocator never hands out.
+    ``cache_len``    (B,) int32 — tokens already written for the slot;
+                     this call's token i lands at global position
+                     cache_len + i.
+    ``lengths``      (B,) int32 — valid tokens in THIS call (prefill:
+                     the real prompt length inside the padded bucket;
+                     decode: 1 for active slots, 0 for empty ones).
+                     Writes beyond it are routed to the garbage block.
+
+    ``num_blocks`` / ``block_size`` are static (pytree metadata): one
+    engine → one compiled program shape.
+    """
+
+    block_table: jax.Array
+    cache_len: jax.Array
+    lengths: jax.Array
+    num_blocks: int = flax.struct.field(pytree_node=False)
+    block_size: int = flax.struct.field(pytree_node=False)
+
+
+def paged_update(
+    key_pool: jax.Array,
+    value_pool: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    state: PagedKVState,
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter one call's K/V into the block pools.
+
+    ``k``/``v``: (B, S, Hkv, D); token i of slot b belongs at global
+    position ``cache_len[b] + i``, which lives in table slot
+    ``pos // block_size`` at offset ``pos % block_size``. Positions at or
+    beyond ``lengths[b]`` (bucket padding, inactive decode slots) are
+    rerouted to reserved block 0 — real blocks are never handed out as 0,
+    so garbage can never collide with live data. Static shapes: one
+    compiled scatter regardless of how full any sequence is.
+    """
+    b, s = k.shape[:2]
+    bs = state.block_size
+    max_blocks = state.block_table.shape[1]
+    pos = state.cache_len[:, None] + jnp.arange(s)[None, :]  # (B, S) global
+    valid = jnp.arange(s)[None, :] < state.lengths[:, None]
+    tbl = jnp.clip(pos // bs, 0, max_blocks - 1)
+    blocks = jnp.take_along_axis(state.block_table, tbl, axis=1)
+    blocks = jnp.where(valid, blocks, 0)
+    offsets = pos % bs
+    bf, of = blocks.reshape(-1), offsets.reshape(-1)
+    kf = k.reshape(b * s, *k.shape[2:])
+    vf = v.reshape(b * s, *v.shape[2:])
+    return key_pool.at[bf, of].set(kf), value_pool.at[bf, of].set(vf)
+
+
+def paged_attention(
+    q: jax.Array,
+    key_pool: jax.Array,
+    value_pool: jax.Array,
+    state: PagedKVState,
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    window=None,
+) -> jax.Array:
+    """Attention read through the block table: gather each slot's blocks
+    into a (B, max_blocks*block_size, Hkv, D) view and run the xla path
+    over it. Because the table is indexed by ``pos // block_size``,
+    gathered column j IS global position j, so the decode mask is the
+    same globally-anchored band as the dense cache path: query at global
+    row r sees column c iff ``c <= r`` (and ``c > r - window`` under a
+    sliding band). Table tail entries point at the garbage block, whose
+    columns sit beyond every row and mask out. One compiled program for
+    prefill (B=1, S=bucket) and decode (B=slots, S=1) alike.
+    """
+    b, s = q.shape[:2]
+    bs = state.block_size
+    max_blocks = state.block_table.shape[1]
+    k = key_pool[state.block_table].reshape(
+        b, max_blocks * bs, *key_pool.shape[2:]
+    )
+    v = value_pool[state.block_table].reshape(
+        b, max_blocks * bs, *value_pool.shape[2:]
+    )
+    rows = (state.cache_len[:, None] + jnp.arange(s)[None, :])[:, None, :, None]
+    cols = jnp.arange(max_blocks * bs)[None, None, None, :]
+    keep = cols <= rows  # (B, 1, S, K)
+    if window is not None:
+        keep = jnp.logical_and(keep, cols > rows - window)
+    return xla_attention(
+        q, k, v, mask=keep, causal=False, scale=scale, softcap=softcap
+    )
 
 
 def make_causal_mask(

@@ -1,0 +1,34 @@
+"""TPU-native serving: continuous batching over a paged KV cache.
+
+The decode path for heavy traffic (ROADMAP north star: millions of
+users): a fixed-size slot batch whose seats are refilled at EVERY decode
+step (Orca-style iteration-level batching), backed by a block-table
+paged KV cache (vLLM's PagedAttention translated to static-shape XLA —
+preallocated pools + gather/scatter indices, zero retraces after
+warmup). Entry point: :class:`ServingEngine` — ``add_request`` /
+``step`` / ``stream``, with per-request TTFT / tokens-per-second
+telemetry riding the existing sink stack as ``kind="serve"`` records.
+"""
+
+from ..ops.attention import PagedKVState, paged_attention, paged_update
+from .block_pool import BlockPool
+from .engine import ServingEngine, TokenEvent
+from .sampling import SlotSampling, sample_tokens
+from .scheduler import ContinuousScheduler, Request, Slot
+from .telemetry import ServeStats, percentile
+
+__all__ = [
+    "BlockPool",
+    "ContinuousScheduler",
+    "PagedKVState",
+    "Request",
+    "ServeStats",
+    "ServingEngine",
+    "Slot",
+    "SlotSampling",
+    "TokenEvent",
+    "paged_attention",
+    "paged_update",
+    "percentile",
+    "sample_tokens",
+]

@@ -1,0 +1,85 @@
+"""Host-side allocator for the device KV block pools.
+
+The device side (``ops/attention.py``: pools as flax cache variables,
+``PagedKVState`` indexing) is pure data movement — POLICY lives here, on
+the host, where a free list costs nanoseconds instead of a recompile.
+Block 0 is reserved as the garbage block: the device routes every
+invalid write (bucket padding, inactive decode slots) there, so the
+allocator must never hand it out.
+"""
+
+from __future__ import annotations
+
+
+class BlockPool:
+    """Free-list over ``num_blocks`` KV blocks of ``block_size`` tokens.
+
+    Allocation is all-or-nothing per request (the scheduler reserves a
+    request's FULL worst-case footprint at admission — see
+    ``ContinuousScheduler.admit``), frees return blocks for immediate
+    reuse, and double-free / foreign-block frees raise instead of
+    corrupting a neighbour's cache.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the reserved garbage "
+                f"block), got {num_blocks}"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> lowest id
+        self._allocated: set[int] = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    def blocks_for_tokens(self, tokens: int) -> int:
+        """ceil(tokens / block_size) — the sizing formula. A request
+        needs ``blocks_for_tokens(prompt_len + max_new_tokens)`` blocks."""
+        return -(-max(tokens, 0) // self.block_size)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, n: int) -> list[int]:
+        """Take ``n`` blocks or raise — the caller must gate on
+        :meth:`can_allocate` (the scheduler's admission check)."""
+        if n > len(self._free):
+            raise RuntimeError(
+                f"block pool exhausted: need {n}, have {len(self._free)} "
+                f"free of {self.num_blocks - 1} allocatable"
+            )
+        blocks = [self._free.pop() for _ in range(n)]
+        self._allocated.update(blocks)
+        return blocks
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b not in self._allocated:
+                raise ValueError(
+                    f"freeing block {b} that is not allocated (double free "
+                    f"or foreign block)"
+                )
+            self._allocated.remove(b)
+            self._free.append(b)
+
+    def stats(self) -> dict:
+        """Occupancy snapshot; ``utilization`` counts only allocatable
+        blocks (the garbage block is overhead, not capacity)."""
+        usable = self.num_blocks - 1
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "free": len(self._free),
+            "allocated": len(self._allocated),
+            "utilization": len(self._allocated) / usable if usable else 0.0,
+        }

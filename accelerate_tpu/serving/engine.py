@@ -1,0 +1,352 @@
+"""The step-level serving engine: continuous batching over a paged KV
+cache.
+
+``ServingEngine`` is the host-side driver the million-user decode path
+needs: ``add_request`` enqueues work, ``step`` advances the whole slot
+batch by one decode iteration (retire finished -> admit + prefill ->
+decode), ``stream`` drives steps to completion yielding per-token
+events. Two compiled programs do all device work after warmup:
+
+* ONE decode step at the fixed ``(max_slots, 1)`` shape — request churn
+  (admissions, evictions, heterogeneous depths) is pure traced data
+  (block tables, cache lengths, per-slot temperatures), so the program
+  never retraces;
+* one prefill per power-of-two bucket width (<= log2(max_seq_len) of
+  them ever) — a long prompt runs as its own bucketed call writing into
+  the paged cache instead of stalling the decode batch (prefill/decode
+  split).
+
+Zero-retrace is an explicit contract: trace-time counters
+(:meth:`ServingEngine.trace_counts`) let tests assert it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.attention import PagedKVState
+from .block_pool import BlockPool
+from .sampling import SlotSampling, sample_tokens
+from .scheduler import ContinuousScheduler, Request, Slot
+from .telemetry import ServeStats
+
+
+@dataclass(frozen=True)
+class TokenEvent:
+    """One generated token, as surfaced by ``step``/``stream``."""
+
+    request_id: str
+    token: int
+    done: bool
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+
+
+class ServingEngine:
+    """Continuous-batching serving over a paged KV cache.
+
+    ``num_blocks`` defaults to a pool that can hold ``max_slots`` full
+    ``max_seq_len`` sequences plus the reserved garbage block — the
+    worst case. Real traffic with shorter sequences can shrink it: a
+    request needs ``ceil((prompt_len + max_new_tokens) / block_size)``
+    blocks while in flight (the block-pool sizing formula), and the pool
+    only has to fund the slots' CONCURRENT reservations, which is where
+    paging beats the dense ``[B, max_seq_len]`` cache on HBM.
+
+    ``telemetry``: an optional :class:`~..telemetry.StepTelemetry`; every
+    completed request emits a ``kind="serve"`` record through it (TTFT,
+    queue time, end-to-end latency, decode tokens/s) — the records ride
+    the existing sink/diagnostics stack unchanged. ``now`` is injectable
+    for deterministic latency tests.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        *,
+        max_slots: int = 4,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        telemetry: Any = None,
+        seed: int = 0,
+        now: Callable[[], float] = time.monotonic,
+    ):
+        self.model = model
+        self.params = params
+        self.max_slots = max_slots
+        self.block_size = block_size
+        cfg = model.config
+        self._max_table = -(-cfg.max_seq_len // block_size)
+        if num_blocks is None:
+            num_blocks = max_slots * self._max_table + 1
+        self.num_blocks = num_blocks
+        self.pool = BlockPool(num_blocks, block_size)
+        self.scheduler = ContinuousScheduler(max_slots, self.pool, now=now)
+        self.sampling = SlotSampling(max_slots)
+        self.stats = ServeStats()
+        self._telemetry = telemetry
+        self._now = now
+        self._key = jax.random.PRNGKey(seed)
+        self._tables = np.zeros((max_slots, self._max_table), np.int32)
+        self._results: dict[str, list[int]] = {}
+        self._traces = {"prefill": 0, "decode": 0}
+
+        from ..models.generation import init_cache
+
+        init_state = PagedKVState(
+            block_table=jnp.zeros((1, self._max_table), jnp.int32),
+            cache_len=jnp.zeros((1,), jnp.int32),
+            lengths=jnp.ones((1,), jnp.int32),
+            num_blocks=num_blocks,
+            block_size=block_size,
+        )
+        self.cache = init_cache(
+            model.init, jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
+            decode=True, paged=init_state,
+        )
+
+        traces = self._traces
+
+        def _prefill(params, cache, ids, table, length, key, temp):
+            traces["prefill"] += 1  # trace-time counter (not per call)
+            state = PagedKVState(
+                block_table=table,
+                cache_len=jnp.zeros((1,), jnp.int32),
+                lengths=length,
+                num_blocks=num_blocks,
+                block_size=block_size,
+            )
+            logits, mutated = model.apply(
+                {"params": params, "cache": cache}, ids, decode=True,
+                paged=state, mutable=["cache"],
+            )
+            # last VALID row of the padded bucket, not the padded tail
+            last = jnp.take_along_axis(
+                logits, (length - 1)[:, None, None], axis=1
+            )[:, 0]
+            token = sample_tokens(last, key, temp, top_k=top_k, top_p=top_p)
+            return mutated["cache"], token
+
+        def _decode(params, cache, tokens, tables, cache_lens, lengths,
+                    temps, key):
+            traces["decode"] += 1  # zero-retrace contract rides on this
+            state = PagedKVState(
+                block_table=tables,
+                cache_len=cache_lens,
+                lengths=lengths,
+                num_blocks=num_blocks,
+                block_size=block_size,
+            )
+            logits, mutated = model.apply(
+                {"params": params, "cache": cache}, tokens, decode=True,
+                paged=state, mutable=["cache"],
+            )
+            token = sample_tokens(
+                logits[:, -1], key, temps, top_k=top_k, top_p=top_p
+            )
+            return mutated["cache"], token
+
+        self._prefill_fn = jax.jit(_prefill)
+        self._decode_fn = jax.jit(_decode)
+
+    # ------------------------------------------------------------------ #
+    # request API
+    # ------------------------------------------------------------------ #
+    def add_request(
+        self,
+        prompt,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        eos_token_id: Optional[int] = None,
+        request_id: str = "",
+    ) -> str:
+        """Enqueue one request; returns its id. ``prompt`` is a token-id
+        sequence. The request is admitted into a slot by a later
+        :meth:`step` as soon as a seat AND its full block reservation are
+        available."""
+        req = Request(
+            prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            eos_token_id=eos_token_id,
+            request_id=request_id,
+        )
+        return self.scheduler.submit(req)
+
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.has_work
+
+    def trace_counts(self) -> dict:
+        """{"prefill": n, "decode": m} — compiled-program counts, bumped
+        at trace time. After warmup, steady-state serving must hold
+        decode at 1 and prefill at <= log2(max_seq_len)."""
+        return dict(self._traces)
+
+    def result(self, request_id: str) -> Optional[list[int]]:
+        """Generated tokens of a COMPLETED request (None while running)."""
+        return self._results.get(request_id)
+
+    # ------------------------------------------------------------------ #
+    # the step loop
+    # ------------------------------------------------------------------ #
+    def step(self) -> list[TokenEvent]:
+        """Advance serving by one iteration: retire finished slots (their
+        blocks free immediately), admit + prefill queued requests into
+        the empty seats, then run ONE decode step over the whole slot
+        batch. Returns the tokens produced this iteration."""
+        events: list[TokenEvent] = []
+        for slot in self.scheduler.slots:
+            if slot.busy and slot.done:
+                self._finish(slot)
+        for slot in self.scheduler.admit():
+            self._prefill_slot(slot, events)
+        active = [s for s in self.scheduler.slots if s.busy and not s.done]
+        if active:
+            self._decode_step(active, events)
+        return events
+
+    def stream(self) -> Iterator[TokenEvent]:
+        """Drive :meth:`step` until all submitted work completes,
+        yielding token events as they are produced."""
+        while self.scheduler.has_work:
+            yield from self.step()
+
+    def generate(
+        self,
+        input_ids,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        eos_token_id: Optional[int] = None,
+    ) -> jax.Array:
+        """The classic fixed-batch ``generate`` API refactored onto the
+        engine: every row becomes a request, the engine serves them (one
+        paged prefill per row + continuous decode), and the outputs
+        reassemble into the familiar ``(B, prompt_len + max_new_tokens)``
+        array — EOS-finished rows padded with EOS, matching
+        ``models.generation.generate``'s freeze semantics."""
+        ids = np.asarray(input_ids)
+        req_ids = [
+            self.add_request(
+                row, max_new_tokens=max_new_tokens, temperature=temperature,
+                eos_token_id=eos_token_id,
+            )
+            for row in ids
+        ]
+        for _ in self.stream():
+            pass
+        rows = []
+        for rid, prompt in zip(req_ids, ids):
+            gen = list(self._results[rid])
+            pad = eos_token_id if eos_token_id is not None else (
+                gen[-1] if gen else 0
+            )
+            gen += [pad] * (max_new_tokens - len(gen))
+            rows.append(np.concatenate([prompt, np.asarray(gen, ids.dtype)]))
+        return jnp.asarray(np.stack(rows))
+
+    # ------------------------------------------------------------------ #
+    # internals
+    # ------------------------------------------------------------------ #
+    def _split_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _prefill_slot(self, slot: Slot, events: list[TokenEvent]) -> None:
+        req = slot.request
+        prompt_len = len(req.prompt)
+        bucket = _next_pow2(prompt_len)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :prompt_len] = req.prompt
+        table = np.zeros((1, self._max_table), np.int32)
+        table[0, :len(slot.blocks)] = slot.blocks
+        self.cache, token = self._prefill_fn(
+            self.params, self.cache, jnp.asarray(ids), jnp.asarray(table),
+            jnp.asarray([prompt_len], jnp.int32), self._split_key(),
+            jnp.asarray([req.temperature], jnp.float32),
+        )
+        token = int(np.asarray(token)[0])
+        slot.cache_len = prompt_len
+        slot.pending = token
+        slot.generated = [token]
+        slot.first_token_time = self._now()
+        self._tables[slot.index] = table[0]
+        self.sampling.set_slot(slot.index, req.temperature)
+        self._note_token(slot, token, events)
+
+    def _decode_step(self, active: list[Slot], events: list[TokenEvent]) -> None:
+        tokens = np.zeros((self.max_slots, 1), np.int32)
+        cache_lens = np.zeros(self.max_slots, np.int32)
+        lengths = np.zeros(self.max_slots, np.int32)
+        for slot in active:
+            tokens[slot.index, 0] = slot.pending
+            cache_lens[slot.index] = slot.cache_len
+            lengths[slot.index] = 1
+        self.cache, out = self._decode_fn(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(self._tables), jnp.asarray(cache_lens),
+            jnp.asarray(lengths), self.sampling.temperatures(),
+            self._split_key(),
+        )
+        out = np.asarray(out)
+        for slot in active:
+            token = int(out[slot.index])
+            slot.cache_len += 1  # the fed token was written this step
+            slot.pending = token
+            slot.generated.append(token)
+            self._note_token(slot, token, events)
+
+    def _note_token(self, slot: Slot, token: int,
+                    events: list[TokenEvent]) -> None:
+        req = slot.request
+        done = (
+            len(slot.generated) >= req.max_new_tokens
+            or (req.eos_token_id is not None and token == req.eos_token_id)
+        )
+        if done:
+            slot.done = True
+            slot.finish_time = self._now()
+        events.append(TokenEvent(req.request_id, token, done))
+
+    def _finish(self, slot: Slot) -> None:
+        req = slot.request
+        n_new = len(slot.generated)
+        decode_s = slot.finish_time - slot.first_token_time
+        record = {
+            "request_id": req.request_id,
+            "prompt_tokens": len(req.prompt),
+            "new_tokens": n_new,
+            "queue_s": slot.admit_time - req.submit_time,
+            "ttft_s": slot.first_token_time - req.submit_time,
+            "e2e_s": slot.finish_time - req.submit_time,
+            "decode_tokens_per_s": (
+                (n_new - 1) / decode_s if n_new > 1 and decode_s > 0 else None
+            ),
+        }
+        self.stats.add(record)
+        if self._telemetry is not None:
+            self._telemetry.record_serve(**record)
+        self._results[req.request_id] = list(slot.generated)
+        self.sampling.clear_slot(slot.index)
+        self._tables[slot.index] = 0
+        self.scheduler.release(slot)
+
+    def summary(self) -> dict:
+        """Aggregate serve metrics: the :class:`ServeStats` percentile
+        block plus live pool occupancy and compile counts."""
+        return {
+            **self.stats.summary(),
+            "pool": self.pool.stats(),
+            "traces": self.trace_counts(),
+        }

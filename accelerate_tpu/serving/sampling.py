@@ -1,0 +1,59 @@
+"""Per-slot sampling state for the continuous decode batch.
+
+The decode step samples every slot in one compiled call, but slots carry
+DIFFERENT requests — so temperature is a traced ``(B,)`` array (slot
+values change every admission without retracing) while top-k/top-p stay
+engine-global statics (they change the compiled filter shape).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generation import _filter_logits
+
+
+def sample_tokens(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: jax.Array,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """(B, V) logits + (B,) per-slot temperatures -> (B,) token ids.
+
+    Rows with ``temperature == 0`` are greedy; others sample from their
+    temperature-scaled (and top-k/top-p filtered) distribution with a
+    per-slot key split — one slot's randomness never depends on which
+    other requests share the batch.
+    """
+    greedy = jnp.argmax(logits, axis=-1)
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = _filter_logits(
+        logits.astype(jnp.float32) / safe_t, top_k, top_p
+    )
+    keys = jax.random.split(key, logits.shape[0])
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+class SlotSampling:
+    """Host mirror of per-slot sampling parameters. The engine updates a
+    slot's entry at admit/release and ships the array with each decode
+    step — values are traced data, so churn never retraces."""
+
+    def __init__(self, max_slots: int):
+        self._temperature = np.zeros(max_slots, np.float32)
+
+    def set_slot(self, index: int, temperature: float) -> None:
+        self._temperature[index] = temperature
+
+    def clear_slot(self, index: int) -> None:
+        self._temperature[index] = 0.0
+
+    def temperatures(self) -> jax.Array:
+        return jnp.asarray(self._temperature)

@@ -1,0 +1,155 @@
+"""Continuous (iteration-level) batching: Orca's insight in host code.
+
+A fixed array of decode SLOTS is the device-side batch (static shape —
+the decode step compiles once); requests flow through it. At every step
+boundary the engine retires finished slots (their blocks return to the
+pool immediately) and :meth:`ContinuousScheduler.admit` refills them
+from the FIFO queue — a long request never holds the whole batch
+hostage the way run-to-completion batching does.
+
+Admission reserves a request's FULL worst-case KV footprint
+(``ceil((prompt_len + max_new_tokens) / block_size)`` blocks) up front:
+deliberately conservative — an admitted request can never OOM
+mid-flight, so there is no preemption/swap path to get wrong. The cost
+is queueing earlier than an on-demand-growth scheduler would; for
+bounded ``max_new_tokens`` serving that is the right trade.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .block_pool import BlockPool
+
+_request_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request. ``prompt`` is a token-id list (tokenizers
+    live outside this engine); timing fields are stamped by the
+    scheduler/engine clock."""
+
+    prompt: list[int]
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    eos_token_id: Optional[int] = None
+    request_id: str = ""
+    submit_time: float = 0.0
+
+    def __post_init__(self):
+        if not self.request_id:
+            self.request_id = f"req-{next(_request_counter)}"
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+@dataclass
+class Slot:
+    """One seat in the fixed decode batch plus its per-request state."""
+
+    index: int
+    request: Optional[Request] = None
+    blocks: list[int] = field(default_factory=list)
+    cache_len: int = 0          # tokens written into the paged cache
+    generated: list[int] = field(default_factory=list)
+    pending: int = 0            # last sampled token, fed to the next step
+    done: bool = False
+    admit_time: float = 0.0
+    first_token_time: float = 0.0
+    finish_time: float = 0.0
+
+    @property
+    def busy(self) -> bool:
+        return self.request is not None
+
+    def clear(self) -> None:
+        self.request = None
+        self.blocks = []
+        self.cache_len = 0
+        self.generated = []
+        self.pending = 0
+        self.done = False
+        self.admit_time = 0.0
+        self.first_token_time = 0.0
+        self.finish_time = 0.0
+
+
+class ContinuousScheduler:
+    """Slot admission/eviction policy. ``now`` is injectable (fake-clock
+    tests drive queueing-time accounting deterministically)."""
+
+    def __init__(
+        self,
+        max_slots: int,
+        pool: BlockPool,
+        now: Callable[[], float] = time.monotonic,
+    ):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.slots = [Slot(i) for i in range(max_slots)]
+        self.pool = pool
+        self.queue: deque[Request] = deque()
+        self._now = now
+        max_tokens = (pool.num_blocks - 1) * pool.block_size
+        self.max_request_tokens = max_tokens
+
+    def submit(self, request: Request) -> str:
+        need = self.pool.blocks_for_tokens(
+            len(request.prompt) + request.max_new_tokens
+        )
+        if need > self.pool.num_blocks - 1:
+            raise ValueError(
+                f"request needs {need} blocks "
+                f"({len(request.prompt)} prompt + {request.max_new_tokens} "
+                f"new tokens) but the pool only has "
+                f"{self.pool.num_blocks - 1} allocatable blocks total"
+            )
+        request.submit_time = self._now()
+        self.queue.append(request)
+        return request.request_id
+
+    def release(self, slot: Slot) -> None:
+        """Return a finished slot's blocks and empty the seat — the very
+        next :meth:`admit` can refill it (continuous batching's point)."""
+        if slot.blocks:
+            self.pool.free(slot.blocks)
+        slot.clear()
+
+    def admit(self) -> list[Slot]:
+        """Fill free slots from the queue head while the pool can fund
+        each request's full reservation. Strict FIFO: a head request that
+        doesn't fit blocks later ones (no starvation of big requests)."""
+        admitted = []
+        free_slots = (s for s in self.slots if not s.busy)
+        while self.queue:
+            slot = next(free_slots, None)
+            if slot is None:
+                break
+            req = self.queue[0]
+            need = self.pool.blocks_for_tokens(
+                len(req.prompt) + req.max_new_tokens
+            )
+            if not self.pool.can_allocate(need):
+                break
+            self.queue.popleft()
+            slot.clear()
+            slot.request = req
+            slot.blocks = self.pool.allocate(need)
+            slot.admit_time = self._now()
+            admitted.append(slot)
+        return admitted
+
+    @property
+    def active(self) -> list[Slot]:
+        return [s for s in self.slots if s.busy]
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(s.busy for s in self.slots)

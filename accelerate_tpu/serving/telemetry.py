@@ -1,0 +1,62 @@
+"""Per-request serving metrics: percentile summaries over serve records.
+
+The wire format is the collector's ``kind="serve"`` record (one per
+COMPLETED request — see ``telemetry/sinks.py`` for the schema); this
+module is the in-process aggregation the engine and the bench read
+back: p50/p95 TTFT, end-to-end latency, per-request decode tokens/s.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def percentile(values: Sequence[float], q: float) -> Optional[float]:
+    """Linear-interpolation percentile (numpy's default) without
+    requiring the values to be a numpy array; None on empty input."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return vals[0]
+    pos = (len(vals) - 1) * q / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(vals) - 1)
+    return vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
+
+
+PERCENTILE_FIELDS = ("ttft_s", "e2e_s", "queue_s", "decode_tokens_per_s")
+
+
+class ServeStats:
+    """Accumulates per-request serve records; :meth:`summary` folds them
+    into the p50/p95 block the engine, the bench variant, and README's
+    schema all share."""
+
+    def __init__(self):
+        self.requests: list[dict] = []
+
+    def add(self, record: dict) -> None:
+        self.requests.append(dict(record))
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+    def summary(self) -> dict:
+        out: dict = {
+            "requests": len(self.requests),
+            "prompt_tokens": sum(
+                int(r.get("prompt_tokens") or 0) for r in self.requests
+            ),
+            "new_tokens": sum(
+                int(r.get("new_tokens") or 0) for r in self.requests
+            ),
+        }
+        for field in PERCENTILE_FIELDS:
+            vals = [
+                r[field] for r in self.requests
+                if r.get(field) is not None
+            ]
+            out[f"{field}_p50"] = percentile(vals, 50)
+            out[f"{field}_p95"] = percentile(vals, 95)
+        return out

@@ -406,6 +406,43 @@ class StepTelemetry:
         self._emit(record)
         return record
 
+    def record_serve(
+        self,
+        *,
+        request_id: str,
+        prompt_tokens: int,
+        new_tokens: int,
+        queue_s: Optional[float] = None,
+        ttft_s: Optional[float] = None,
+        e2e_s: Optional[float] = None,
+        decode_tokens_per_s: Optional[float] = None,
+        label: str = "serve",
+        **extra: Any,
+    ) -> Optional[dict]:
+        """Emit a ``kind="serve"`` record — one COMPLETED serving request
+        (the ServingEngine calls this at slot retirement). Flows through
+        the same sinks as step records; the Prometheus sink folds the
+        latency fields into rolling p50/p95/p99 summaries. None while
+        disabled."""
+        if not self.enabled:
+            return None
+        record: dict[str, Any] = {
+            "kind": "serve",
+            "label": label,
+            "time_unix": time.time(),
+            "request_id": request_id,
+            "prompt_tokens": int(prompt_tokens),
+            "new_tokens": int(new_tokens),
+            "queue_s": queue_s,
+            "ttft_s": ttft_s,
+            "e2e_s": e2e_s,
+            "decode_tokens_per_s": decode_tokens_per_s,
+        }
+        for key, value in extra.items():
+            record.setdefault(key, value)
+        self._emit(record)
+        return record
+
     # ------------------------------------------------------------------ #
     # reporting / lifecycle
     # ------------------------------------------------------------------ #

@@ -72,6 +72,24 @@ background writer thread, after the commit rename)::
     write_bandwidth_bytes_per_s float? bytes / IO seconds (background_s
                                        for async, blocked_s for sync)
 
+``kind="serve"`` (one per COMPLETED serving request, emitted by the
+ServingEngine at slot retirement)::
+
+    request_id           str    engine-assigned (or caller-supplied) id
+    prompt_tokens        int    prompt length in tokens
+    new_tokens           int    tokens actually generated (<= max_new:
+                                EOS stops early)
+    queue_s              float? submit -> slot admission wait
+    ttft_s               float? submit -> first token (queue + prefill)
+    e2e_s                float? submit -> final token
+    decode_tokens_per_s  float? steady-state decode rate for THIS request
+                                (excludes the prefill token; null for
+                                single-token generations)
+
+    The Prometheus sink exports the four latency fields as summaries —
+    rolling-window p50/p95/p99 quantile lines plus cumulative _count and
+    _sum — instead of last-value gauges.
+
 ``kind="goodput"`` (every ``goodput_interval`` steps when diagnostics is
 on; the wall-clock attribution fold)::
 
@@ -111,6 +129,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from collections import deque
 from typing import Any, Iterable, Optional, Union
 
 from ..logging import get_logger
@@ -169,6 +188,30 @@ _PROM_RENAMES = {
     "schema": None,
 }
 
+# serve-record latency fields exported as Prometheus SUMMARIES (quantile
+# lines + _count/_sum) rather than last-value gauges — a per-request
+# latency gauge is meaningless the moment the next request lands
+_SERVE_SUMMARY_FIELDS = {
+    "ttft_s": "serve_ttft_seconds",
+    "e2e_s": "serve_e2e_seconds",
+    "queue_s": "serve_queue_seconds",
+    "decode_tokens_per_s": "serve_decode_tokens_per_second",
+}
+
+_SERVE_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _quantile(values: list, q: float) -> float:
+    """Linear-interpolation quantile (q in [0, 1]) over a non-empty
+    list — numpy's default method, without numpy."""
+    vals = sorted(values)
+    if len(vals) == 1:
+        return vals[0]
+    pos = (len(vals) - 1) * q
+    lo = int(pos)
+    hi = min(lo + 1, len(vals) - 1)
+    return vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
+
 
 class PrometheusTextSink(TelemetrySink):
     """Latest-value gauges in Prometheus text exposition format, written
@@ -176,16 +219,31 @@ class PrometheusTextSink(TelemetrySink):
     textfile collector (or a sidecar cat) at it. No client library, no
     daemon: the step loop is the exporter."""
 
-    def __init__(self, path: Union[str, os.PathLike], prefix: str = "accelerate_tpu"):
+    def __init__(
+        self,
+        path: Union[str, os.PathLike],
+        prefix: str = "accelerate_tpu",
+        summary_window: int = 1024,
+    ):
         self.path = os.fspath(path)
         self.prefix = prefix
         parent = os.path.dirname(self.path)
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._gauges: dict[tuple[str, str], float] = {}  # (metric, label) -> value
+        # (metric, label) -> rolling observation window for quantiles;
+        # _count/_sum stay cumulative (Prometheus summary semantics)
+        self._summary_window = int(summary_window)
+        self._summaries: dict[tuple[str, str], deque] = {}
+        self._summary_counts: dict[tuple[str, str], int] = {}
+        self._summary_sums: dict[tuple[str, str], float] = {}
 
     def emit(self, record: dict) -> None:
-        if record.get("kind") not in (None, "step", "goodput"):
+        kind = record.get("kind")
+        if kind == "serve":
+            self._emit_serve(record)
+            return
+        if kind not in (None, "step", "goodput"):
             return
         label = str(record.get("label", "step"))
         for key, value in record.items():
@@ -195,6 +253,28 @@ class PrometheusTextSink(TelemetrySink):
             if name is None:
                 continue
             self._gauges[(f"{self.prefix}_{name}", label)] = float(value)
+        self._write()
+
+    def _emit_serve(self, record: dict) -> None:
+        label = str(record.get("label", "serve"))
+        for key, value in record.items():
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            name = _SERVE_SUMMARY_FIELDS.get(key)
+            if name is not None:
+                slot = (f"{self.prefix}_{name}", label)
+                window = self._summaries.setdefault(
+                    slot, deque(maxlen=self._summary_window)
+                )
+                window.append(float(value))
+                self._summary_counts[slot] = self._summary_counts.get(slot, 0) + 1
+                self._summary_sums[slot] = (
+                    self._summary_sums.get(slot, 0.0) + float(value)
+                )
+                continue
+            if _PROM_RENAMES.get(key, key) is None:
+                continue
+            self._gauges[(f"{self.prefix}_serve_{key}", label)] = float(value)
         self._write()
 
     @staticmethod
@@ -213,13 +293,33 @@ class PrometheusTextSink(TelemetrySink):
                 if m == metric:
                     escaped = self._escape_label(label)
                     lines.append(f'{metric}{{label="{escaped}"}} {value}')
+        for metric in sorted({m for m, _ in self._summaries}):
+            lines.append(f"# TYPE {metric} summary")
+            for (m, label), window in sorted(self._summaries.items()):
+                if m != metric or not window:
+                    continue
+                escaped = self._escape_label(label)
+                values = list(window)
+                for q in _SERVE_QUANTILES:
+                    lines.append(
+                        f'{metric}{{label="{escaped}",quantile="{q}"}} '
+                        f"{_quantile(values, q)}"
+                    )
+                lines.append(
+                    f'{metric}_count{{label="{escaped}"}} '
+                    f"{self._summary_counts[(m, label)]}"
+                )
+                lines.append(
+                    f'{metric}_sum{{label="{escaped}"}} '
+                    f"{self._summary_sums[(m, label)]}"
+                )
         tmp = f"{self.path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write("\n".join(lines) + "\n")
         os.replace(tmp, self.path)  # scrapers never see a torn file
 
     def close(self) -> None:
-        if self._gauges:
+        if self._gauges or self._summaries:
             self._write()
 
 

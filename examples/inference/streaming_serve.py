@@ -1,0 +1,109 @@
+"""Streaming serving with continuous batching over a paged KV cache.
+
+The step-level serving idiom for the heavy-traffic decode path: requests
+of wildly different prompt lengths and token budgets are enqueued into a
+:class:`~accelerate_tpu.serving.ServingEngine`, which packs them into a
+fixed slot batch, refills finished seats at EVERY decode step, and
+streams per-token events as they are produced — no request waits out a
+longer neighbour's budget. After warmup the whole workload runs on one
+compiled decode program plus one prefill per power-of-two bucket
+(``engine.trace_counts()`` proves it).
+
+Hub-free: a tiny CausalLM with random weights serves synthetic token-id
+prompts, so the script runs anywhere (single chip, CPU, CI):
+
+    python examples/inference/streaming_serve.py [--requests 6]
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Allow running by path without a pip install: put the repo root on sys.path
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+)
+
+from accelerate_tpu.models import CausalLM, TransformerConfig
+from accelerate_tpu.serving import ServingEngine
+from accelerate_tpu.telemetry import StepTelemetry, TelemetryConfig
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=6)
+    parser.add_argument("--max_slots", type=int, default=2)
+    parser.add_argument("--block_size", type=int, default=8)
+    args = parser.parse_args()
+
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+
+    # every completed request emits a kind="serve" telemetry record
+    # (TTFT, queue time, decode tokens/s) through the normal sink stack
+    telemetry = StepTelemetry(TelemetryConfig(enabled=True))
+    engine = ServingEngine(
+        model,
+        params,
+        max_slots=args.max_slots,
+        block_size=args.block_size,
+        telemetry=telemetry,
+    )
+
+    # mixed-length trace: more requests than slots, uneven budgets —
+    # the continuous scheduler admits into seats as they free up
+    rng = np.random.default_rng(0)
+    req_ids = []
+    for i in range(args.requests):
+        prompt = rng.integers(0, cfg.vocab_size, (3 + 5 * i % 23,)).tolist()
+        rid = engine.add_request(
+            prompt, max_new_tokens=3 + i % 5, temperature=0.7 * (i % 2)
+        )
+        req_ids.append(rid)
+
+    # stream(): tokens arrive per decode step, interleaved across the
+    # requests currently holding slots — this is the serving loop
+    streamed: dict[str, list[int]] = {rid: [] for rid in req_ids}
+    for event in engine.stream():
+        streamed[event.request_id].append(event.token)
+        tag = " <done>" if event.done else ""
+        print(f"  {event.request_id}: token {event.token}{tag}")
+
+    # every request completed, and the streamed tokens are exactly the
+    # per-request results the engine recorded
+    for rid in req_ids:
+        result = engine.result(rid)
+        assert result is not None, f"{rid} never completed"
+        assert streamed[rid] == result, "streamed tokens != stored result"
+
+    summary = engine.summary()
+    assert summary["requests"] == args.requests
+    assert summary["pool"]["allocated"] == 0, "blocks leaked after drain"
+    # the zero-retrace contract: one decode program, bucketed prefills
+    assert summary["traces"]["decode"] == 1
+    serve_records = [
+        r for r in telemetry.records if r.get("kind") == "serve"
+    ]
+    assert len(serve_records) == args.requests
+    telemetry.close()
+
+    print(
+        f"served {summary['requests']} requests "
+        f"({summary['new_tokens']} tokens): "
+        f"ttft_p50={summary['ttft_s_p50']:.4f}s "
+        f"decode_p50={summary['decode_tokens_per_s_p50']:.1f} tok/s, "
+        f"traces={summary['traces']}"
+    )
+    print("streaming serve example passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -214,6 +214,25 @@ def test_memory_example():
 
 
 @pytest.mark.slow
+def test_streaming_serve_example():
+    """The continuous-batching serving example streams more requests
+    than slots to completion and asserts internally: streamed tokens ==
+    stored results, pool fully drained, one compiled decode program, one
+    kind="serve" telemetry record per request."""
+    import runpy
+
+    old_argv = sys.argv
+    sys.argv = ["streaming_serve.py", "--requests", "5"]
+    try:
+        runpy.run_path(
+            str(EXAMPLES / "inference" / "streaming_serve.py"),
+            run_name="__main__",
+        )
+    finally:
+        sys.argv = old_argv
+
+
+@pytest.mark.slow
 def test_big_model_inference_example():
     """Tiered big-model loading ends in identical generations across GSPMD
     and device_map placements (the example asserts it internally). The

@@ -82,6 +82,28 @@ def test_make_generate_fn_jits(tiny_model):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_make_generate_fn_bucketed_prefill_bounds_traces(tiny_model):
+    """The static-prompt-length retrace trap: every distinct prompt
+    length used to compile its own prefill. Power-of-two chunking caps
+    the compiled prefill programs at log2(max_seq_len) across ANY mix of
+    prompt lengths — while matching ``generate`` token-for-token."""
+    import math
+
+    cfg, model, params = tiny_model
+    fn = make_generate_fn(model, max_new_tokens=4)
+    rng = np.random.default_rng(11)
+    for p_len in (1, 3, 5, 7, 9, 13, 17, 23, 31, 42):
+        prompt = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (2, p_len)), jnp.int32
+        )
+        out = fn(params, prompt)
+        want = generate(model, params, prompt, max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+    counts = fn.trace_counts()
+    assert counts["prefill"] <= int(math.log2(cfg.max_seq_len))
+    assert counts["decode"] == 1
+
+
 def test_sharded_generate_matches_single_device():
     """GSPMD serving (VERDICT r4 weak #4): greedy generate() with params
     sharded tp=2 x fsdp=2 (x dp=2) must match the single-logical-device

@@ -279,6 +279,85 @@ def test_prometheus_text_sink(tmp_path):
     sink.close()
 
 
+def _serve_record(i: int, label: str = "serve") -> dict:
+    return {
+        "kind": "serve",
+        "label": label,
+        "time_unix": 100.0 + i,
+        "request_id": f"req-{i}",
+        "prompt_tokens": 13,
+        "new_tokens": 6,
+        "queue_s": 0.01 * i,
+        "ttft_s": 0.1 + 0.01 * i,
+        "e2e_s": 0.5 + 0.02 * i,
+        "decode_tokens_per_s": 100.0 + i,
+    }
+
+
+def test_prometheus_sink_serve_percentile_summaries(tmp_path):
+    """Serve latency fields export as summaries — quantile lines plus
+    cumulative _count/_sum — not last-value gauges."""
+    path = tmp_path / "serve.prom"
+    sink = PrometheusTextSink(str(path))
+    for i in range(10):
+        sink.emit(_serve_record(i))
+    text = path.read_text()
+    assert "# TYPE accelerate_tpu_serve_ttft_seconds summary" in text
+    for q in ("0.5", "0.95", "0.99"):
+        assert f'accelerate_tpu_serve_ttft_seconds{{label="serve",quantile="{q}"}}' in text
+    # p50 of 0.10..0.19 is 0.145 (linear interpolation)
+    assert 'quantile="0.5"} 0.145' in text
+    assert 'accelerate_tpu_serve_ttft_seconds_count{label="serve"} 10' in text
+    assert "accelerate_tpu_serve_e2e_seconds_sum" in text
+    assert "accelerate_tpu_serve_queue_seconds" in text
+    assert "accelerate_tpu_serve_decode_tokens_per_second" in text
+    # counters still appear, as gauges; per-request latencies must not
+    assert 'accelerate_tpu_serve_new_tokens{label="serve"} 6.0' in text
+    assert "# TYPE accelerate_tpu_serve_ttft_seconds gauge" not in text
+    sink.close()
+
+
+def test_prometheus_sink_escapes_serve_labels(tmp_path):
+    r"""Quoted label values must escape backslash, quote and newline or
+    the exposition format breaks mid-scrape."""
+    path = tmp_path / "serve.prom"
+    sink = PrometheusTextSink(str(path))
+    sink.emit(_serve_record(0, label='a"b\nc\\d'))
+    text = path.read_text()
+    assert 'label="a\\"b\\nc\\\\d"' in text
+    assert '\na"b' not in text  # no raw newline smuggled into a label
+    # sanity: the file still parses line-by-line as name{labels} value
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or " " in line
+
+
+def test_record_serve_flows_through_sinks():
+    class CaptureSink:
+        def __init__(self):
+            self.records = []
+
+        def emit(self, record):
+            self.records.append(record)
+
+        def close(self):
+            pass
+
+    cfg = TelemetryConfig(enabled=True, jsonl_path=None)
+    tel = StepTelemetry(cfg)
+    sink = CaptureSink()
+    tel.add_sink(sink)
+    rec = tel.record_serve(
+        request_id="req-9", prompt_tokens=13, new_tokens=6,
+        queue_s=0.0, ttft_s=0.2, e2e_s=0.9, decode_tokens_per_s=7.1,
+    )
+    assert rec["kind"] == "serve" and rec["label"] == "serve"
+    emitted = [r for r in sink.records if r.get("kind") == "serve"]
+    assert len(emitted) == 1
+    assert emitted[0]["request_id"] == "req-9"
+    assert emitted[0]["decode_tokens_per_s"] == 7.1
+    tel.close()
+
+
 def test_tracker_bridge_sink():
     class FakeTracker:
         def __init__(self):
