@@ -30,10 +30,10 @@ FAST_FILES = \
   tests/test_telemetry.py tests/test_compilation.py \
   tests/test_checkpoint_async.py tests/test_fused_accum.py \
   tests/test_diagnostics.py tests/test_benchmarks.py \
-  tests/test_serving.py
+  tests/test_serving.py tests/test_serving_obs.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
-  diag-smoke bench-fast-smoke serve-smoke
+  diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -94,6 +94,18 @@ serve-smoke:
 	  tests/test_serving.py::test_paged_generate_matches_dense_generate \
 	  tests/test_serving.py::test_eos_slot_refill_completes_all_requests
 	python bench.py serve
+
+# serving observability acceptance on CPU: the engine runs under
+# synthetic overload (16 requests vs 2 slots, 4-deep bounded queue,
+# 50ms queue deadline) with the full plane attached — every request
+# finishes or sheds with a terminal span, /metrics serves live gauges
+# MID-RUN, the Perfetto trace round-trips, and `accelerate-tpu
+# diagnose` names the shed counts and SLO attainment. The queue-bound
+# and deadline shedding unit tests ride along as fast preflight.
+serve-obs-smoke:
+	$(PYTEST) -q \
+	  tests/test_serving_obs.py::TestSchedulerShedding \
+	  tests/test_serving_obs.py::test_overload_smoke_end_to_end
 
 # diagnostics end-to-end on CPU: a tiny train loop with an injected slow
 # step and an injected NaN gradient runs with the flight recorder on,

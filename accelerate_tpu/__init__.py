@@ -38,11 +38,12 @@ from .local_sgd import LocalSGD
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler
-from .serving import ServingEngine, TokenEvent
+from .serving import SLOConfig, ServingEngine, TokenEvent
 from .state import AcceleratorState, GradientState, PartialState
 from .telemetry import (
     HeartbeatMonitor,
     JSONLSink,
+    MetricsHTTPExporter,
     PrometheusTextSink,
     RecompileDetector,
     StepTelemetry,
@@ -104,6 +105,7 @@ __all__ = [
     "HeartbeatMonitor",
     "scan_heartbeats",
     "JSONLSink",
+    "MetricsHTTPExporter",
     "PrometheusTextSink",
     "TrackerBridgeSink",
     "DiagnosticsConfig",
@@ -116,5 +118,6 @@ __all__ = [
     "build_report",
     "format_report",
     "ServingEngine",
+    "SLOConfig",
     "TokenEvent",
 ]

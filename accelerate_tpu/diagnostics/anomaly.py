@@ -207,6 +207,27 @@ class AnomalyDetector:
             self._windows["grad_norm"].append(float(gnorm))
         return out
 
+    def observe_slo(
+        self,
+        record: dict,
+        now: Optional[float] = None,
+    ) -> list[dict]:
+        """Check one ``kind="slo"`` record: a multi-window burn-rate
+        breach becomes a ``slo_breach`` anomaly (same rate limiting as
+        the step-record types — the tracker emits every interval while
+        burning, the detector emits one alarm per cooldown). The serving
+        engine already did the statistics; this routes the verdict into
+        the anomaly/capture machinery."""
+        if record.get("kind") != "slo" or not record.get("breach"):
+            return []
+        now = time.monotonic() if now is None else now
+        rec = self._fire(
+            "slo_breach", record, now,
+            value=float(record.get("max_burn_rate") or 0.0),
+            breached_objectives=list(record.get("breached_objectives") or []),
+        )
+        return [rec] if rec else []
+
     def summary(self) -> dict:
         return {
             "anomalies": dict(self.counts),

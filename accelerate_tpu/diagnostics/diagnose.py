@@ -124,6 +124,45 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
             elif kind == "heartbeat_stall":
                 stalls += 1
 
+    # --- how was the serving plane doing -------------------------------- #
+    # latest serve_gauge/slo record per rank in each dump's flight ring:
+    # queue/slot/pool posture at death, cumulative shed totals, and SLO
+    # attainment. Only present when serving records exist (training-only
+    # jobs keep the old report shape).
+    serving: dict[int, dict[str, Any]] = {}
+    for rank, dump in dumps.items():
+        gauge = slo = None
+        shed_in_ring = 0
+        for rec in dump.get("records", []):
+            kind = rec.get("kind")
+            if kind == "serve_gauge":
+                gauge = rec  # records are in order: keep the latest
+            elif kind == "slo":
+                slo = rec
+            elif kind == "shed":
+                shed_in_ring += 1
+        if gauge is None and slo is None and shed_in_ring == 0:
+            continue
+        entry: dict[str, Any] = {"shed_records_in_ring": shed_in_ring}
+        if gauge is not None:
+            for key in (
+                "engine_steps", "queue_depth", "queue_age_p95_s",
+                "slots_active", "slot_occupancy", "pool_utilization",
+                "tokens_in_flight",
+                "admission_blocked_no_free_slot_total",
+                "admission_blocked_pool_exhausted_total",
+                "shed_queue_full_total", "shed_queue_deadline_total",
+            ):
+                entry[key] = gauge.get(key)
+        if slo is not None:
+            for key in (
+                "target", "ttft_attainment", "e2e_attainment",
+                "ttft_objective_s", "e2e_objective_s",
+                "max_burn_rate", "breach",
+            ):
+                entry[f"slo_{key}"] = slo.get(key)
+        serving[rank] = entry
+
     return {
         "dir": dir,
         "num_ranks": len(ranks),
@@ -139,6 +178,7 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
         "anomalies": anomalies,
         "heartbeat_stalls": stalls,
         "exceptions": exceptions,
+        "serving": serving,
     }
 
 
@@ -196,6 +236,44 @@ def format_report(report: dict) -> str:
     if anomalies:
         parts = ", ".join(f"{t}={n}" for t, n in sorted(anomalies.items()))
         lines.append(f"Anomalies: {parts}")
+
+    serving = report.get("serving") or {}
+    if serving:
+        lines.append("")
+        lines.append("Serving (latest posture per rank):")
+        for rank in sorted(serving):
+            s = serving[rank]
+            shed_full = s.get("shed_queue_full_total") or 0
+            shed_deadline = s.get("shed_queue_deadline_total") or 0
+            occupancy = s.get("slot_occupancy")
+            pool = s.get("pool_utilization")
+            lines.append(
+                f"  rank {rank}: queue={s.get('queue_depth')} "
+                f"slots={s.get('slots_active')}"
+                + (f" ({occupancy:.0%})" if occupancy is not None else "")
+                + (f" pool={pool:.0%}" if pool is not None else "")
+                + f" shed: queue_full={shed_full} queue_deadline={shed_deadline}"
+            )
+            blocked_slot = s.get("admission_blocked_no_free_slot_total")
+            blocked_pool = s.get("admission_blocked_pool_exhausted_total")
+            if blocked_slot or blocked_pool:
+                lines.append(
+                    f"    admission blocked: no_free_slot={blocked_slot or 0} "
+                    f"pool_exhausted={blocked_pool or 0}"
+                )
+            if s.get("slo_target") is not None:
+                ttft = s.get("slo_ttft_attainment")
+                e2e = s.get("slo_e2e_attainment")
+                lines.append(
+                    f"    SLO (target {s['slo_target']:.2%}): "
+                    + (f"ttft={ttft:.2%}" if ttft is not None else "ttft=n/a")
+                    + (f" e2e={e2e:.2%}" if e2e is not None else " e2e=n/a")
+                    + (
+                        f"  BREACH (burn {s.get('slo_max_burn_rate'):.1f}x)"
+                        if s.get("slo_breach")
+                        else ""
+                    )
+                )
     if report.get("heartbeat_stalls"):
         lines.append(f"Heartbeat stalls recorded: {report['heartbeat_stalls']}")
     for exc in report.get("exceptions", []):

@@ -73,6 +73,23 @@ class DiagnosticsManager:
         if self.goodput is not None:
             self.goodput.observe(record)
         self.recorder.observe(record)
+        if kind == "slo":
+            # the serving SLO tracker did the burn-rate statistics; a
+            # breach gets the same treatment as a detected step anomaly
+            # (alarm record, flight event, optional profile capture)
+            out = []
+            if self.anomaly is not None:
+                for anom in self.anomaly.observe_slo(record):
+                    out.append(anom)
+                    self.recorder.event(
+                        "anomaly",
+                        anomaly_type=anom["anomaly_type"],
+                        value=anom.get("value"),
+                        breached_objectives=anom.get("breached_objectives"),
+                    )
+                    if self.config.capture_on_anomaly:
+                        self.capture.request("anomaly_slo_breach")
+            return out
         if kind != "step":
             return []
 

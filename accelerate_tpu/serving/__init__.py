@@ -15,6 +15,13 @@ from .block_pool import BlockPool
 from .engine import ServingEngine, TokenEvent
 from .sampling import SlotSampling, sample_tokens
 from .scheduler import ContinuousScheduler, Request, Slot
+from .slo import SLOConfig, SloTracker
+from .spans import (
+    RequestSpan,
+    SpanLog,
+    spans_to_chrome_trace,
+    write_chrome_trace,
+)
 from .telemetry import ServeStats, percentile
 
 __all__ = [
@@ -22,13 +29,19 @@ __all__ = [
     "ContinuousScheduler",
     "PagedKVState",
     "Request",
+    "RequestSpan",
+    "SLOConfig",
     "ServeStats",
     "ServingEngine",
     "Slot",
     "SlotSampling",
+    "SloTracker",
+    "SpanLog",
     "TokenEvent",
     "paged_attention",
     "paged_update",
     "percentile",
     "sample_tokens",
+    "spans_to_chrome_trace",
+    "write_chrome_trace",
 ]

@@ -22,6 +22,7 @@ Zero-retrace is an explicit contract: trace-time counters
 
 from __future__ import annotations
 
+import collections
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Iterator, Optional
@@ -34,7 +35,9 @@ from ..ops.attention import PagedKVState
 from .block_pool import BlockPool
 from .sampling import SlotSampling, sample_tokens
 from .scheduler import ContinuousScheduler, Request, Slot
-from .telemetry import ServeStats
+from .slo import SLOConfig, SloTracker
+from .spans import SpanLog, write_chrome_trace
+from .telemetry import ServeStats, percentile
 
 
 @dataclass(frozen=True)
@@ -66,6 +69,25 @@ class ServingEngine:
     queue time, end-to-end latency, decode tokens/s) — the records ride
     the existing sink/diagnostics stack unchanged. ``now`` is injectable
     for deterministic latency tests.
+
+    Observability plane (all host-side — no new traced programs, so the
+    zero-retrace contract is untouched):
+
+    * every request gets a lifecycle SPAN (submit→admit→prefill→first
+      token→finish/shed); terminal transitions emit ``kind="span"``
+      records and :meth:`export_trace` writes the last ``span_history``
+      spans as Chrome-trace/Perfetto JSON;
+    * ``gauge_interval``: every N steps a ``kind="serve_gauge"`` record
+      samples queue depth, queue-age p95, slot occupancy, pool
+      utilization, tokens in flight and the blocked/shed counters;
+    * ``slo``: an optional :class:`SLOConfig`; finished requests feed a
+      multi-window burn-rate tracker emitting ``kind="slo"`` records on
+      ``slo.interval_steps`` cadence (breaches become anomalies);
+    * ``max_queue`` / ``max_queue_delay_s``: bound the admission queue —
+      overloaded traffic is SHED (``kind="shed"`` record + terminal
+      span), never silently parked in an unbounded deque;
+    * ``max_retained_results``: FIFO bound on retained generations —
+      :meth:`result` returns None once a request's tokens age out.
     """
 
     def __init__(
@@ -81,6 +103,12 @@ class ServingEngine:
         telemetry: Any = None,
         seed: int = 0,
         now: Callable[[], float] = time.monotonic,
+        max_queue: Optional[int] = None,
+        max_queue_delay_s: Optional[float] = None,
+        slo: Optional[SLOConfig] = None,
+        gauge_interval: int = 1,
+        span_history: int = 512,
+        max_retained_results: Optional[int] = 4096,
     ):
         self.model = model
         self.params = params
@@ -92,14 +120,30 @@ class ServingEngine:
             num_blocks = max_slots * self._max_table + 1
         self.num_blocks = num_blocks
         self.pool = BlockPool(num_blocks, block_size)
-        self.scheduler = ContinuousScheduler(max_slots, self.pool, now=now)
+        self.scheduler = ContinuousScheduler(
+            max_slots, self.pool, now=now,
+            max_queue=max_queue, max_queue_delay_s=max_queue_delay_s,
+        )
         self.sampling = SlotSampling(max_slots)
         self.stats = ServeStats()
+        self.span_log = SpanLog(maxlen=span_history)
+        self.slo_tracker = SloTracker(slo) if slo is not None else None
+        if gauge_interval < 0:
+            raise ValueError("gauge_interval must be >= 0 (0 disables)")
+        self.gauge_interval = gauge_interval
+        if max_retained_results is not None and max_retained_results < 1:
+            raise ValueError("max_retained_results must be >= 1 (or None)")
+        self.max_retained_results = max_retained_results
         self._telemetry = telemetry
         self._now = now
         self._key = jax.random.PRNGKey(seed)
         self._tables = np.zeros((max_slots, self._max_table), np.int32)
         self._results: dict[str, list[int]] = {}
+        self._result_order: collections.deque = collections.deque()
+        self._shed_reasons: dict[str, str] = {}
+        self._shed_order: collections.deque = collections.deque()
+        self._steps = 0
+        self._http: Any = None
         self._traces = {"prefill": 0, "decode": 0}
 
         from ..models.generation import init_cache
@@ -182,7 +226,11 @@ class ServingEngine:
             eos_token_id=eos_token_id,
             request_id=request_id,
         )
-        return self.scheduler.submit(req)
+        rid = self.scheduler.submit(req)
+        self.span_log.on_submit(rid, req.submit_time, len(req.prompt))
+        if req.shed_reason is not None:  # tail-dropped at the queue bound
+            self._shed(req)
+        return rid
 
     @property
     def has_work(self) -> bool:
@@ -195,26 +243,53 @@ class ServingEngine:
         return dict(self._traces)
 
     def result(self, request_id: str) -> Optional[list[int]]:
-        """Generated tokens of a COMPLETED request (None while running)."""
+        """Generated tokens of a COMPLETED request. None while the
+        request is still running, if it was shed, or after its tokens
+        aged out of the ``max_retained_results`` FIFO window — callers
+        on a long-lived server must read results promptly."""
         return self._results.get(request_id)
+
+    def shed_reason(self, request_id: str) -> Optional[str]:
+        """Why a request was shed (None if it wasn't, or its entry aged
+        out of the bounded shed history)."""
+        return self._shed_reasons.get(request_id)
 
     # ------------------------------------------------------------------ #
     # the step loop
     # ------------------------------------------------------------------ #
     def step(self) -> list[TokenEvent]:
-        """Advance serving by one iteration: retire finished slots (their
-        blocks free immediately), admit + prefill queued requests into
-        the empty seats, then run ONE decode step over the whole slot
-        batch. Returns the tokens produced this iteration."""
+        """Advance serving by one iteration: shed queue-deadline-expired
+        requests, retire finished slots (their blocks free immediately),
+        admit + prefill queued requests into the empty seats, then run
+        ONE decode step over the whole slot batch. Returns the tokens
+        produced this iteration."""
+        had_work = self.scheduler.has_work
         events: list[TokenEvent] = []
+        for req in self.scheduler.shed_expired():
+            self._shed(req)
         for slot in self.scheduler.slots:
             if slot.busy and slot.done:
                 self._finish(slot)
         for slot in self.scheduler.admit():
+            self.span_log.on_admit(slot.request.request_id, slot.admit_time)
             self._prefill_slot(slot, events)
         active = [s for s in self.scheduler.slots if s.busy and not s.done]
         if active:
             self._decode_step(active, events)
+        self._steps += 1
+        if self.gauge_interval and self._steps % self.gauge_interval == 0:
+            self._sample_gauges()
+        if self.slo_tracker is not None and (
+            (
+                self.slo_tracker.config.interval_steps
+                and self._steps % self.slo_tracker.config.interval_steps == 0
+            )
+            # drain edge: the last SLO record in the stream (and the
+            # flight ring) must reflect final end-of-run attainment,
+            # not the cadence snapshot from mid-flight
+            or (had_work and not self.scheduler.has_work)
+        ):
+            self._emit_slo()
         return events
 
     def stream(self) -> Iterator[TokenEvent]:
@@ -248,6 +323,14 @@ class ServingEngine:
             pass
         rows = []
         for rid, prompt in zip(req_ids, ids):
+            if rid not in self._results:
+                reason = self._shed_reasons.get(rid)
+                raise RuntimeError(
+                    f"generate() lost request {rid}: "
+                    + (f"shed ({reason})" if reason else
+                       "result evicted by max_retained_results")
+                    + " — raise max_queue/max_retained_results or batch less"
+                )
             gen = list(self._results[rid])
             pad = eos_token_id if eos_token_id is not None else (
                 gen[-1] if gen else 0
@@ -265,6 +348,7 @@ class ServingEngine:
 
     def _prefill_slot(self, slot: Slot, events: list[TokenEvent]) -> None:
         req = slot.request
+        self.span_log.on_prefill(req.request_id, self._now())
         prompt_len = len(req.prompt)
         bucket = _next_pow2(prompt_len)
         ids = np.zeros((1, bucket), np.int32)
@@ -281,6 +365,7 @@ class ServingEngine:
         slot.pending = token
         slot.generated = [token]
         slot.first_token_time = self._now()
+        self.span_log.on_first_token(req.request_id, slot.first_token_time)
         self._tables[slot.index] = table[0]
         self.sampling.set_slot(slot.index, req.temperature)
         self._note_token(slot, token, events)
@@ -335,18 +420,175 @@ class ServingEngine:
             ),
         }
         self.stats.add(record)
-        if self._telemetry is not None:
-            self._telemetry.record_serve(**record)
+        self._tele("record_serve", **record)
+        span = self.span_log.on_finish(
+            req.request_id, slot.finish_time, n_new
+        )
+        if span is not None:
+            self._tele("record_span", **span.to_record())
+        if self.slo_tracker is not None:
+            self.slo_tracker.observe(
+                slot.finish_time, record["ttft_s"], record["e2e_s"]
+            )
         self._results[req.request_id] = list(slot.generated)
+        self._result_order.append(req.request_id)
+        if self.max_retained_results is not None:
+            while len(self._result_order) > self.max_retained_results:
+                self._results.pop(self._result_order.popleft(), None)
         self.sampling.clear_slot(slot.index)
         self._tables[slot.index] = 0
         self.scheduler.release(slot)
 
+    def _shed(self, req: Request) -> None:
+        """Terminal path for a refused/expired request: close its span
+        as shed, record why (bounded history), and emit the
+        ``kind="shed"`` + ``kind="span"`` records."""
+        now = self._now()
+        reason = req.shed_reason or "unknown"
+        self.stats.add_shed(reason)
+        self._shed_reasons[req.request_id] = reason
+        self._shed_order.append(req.request_id)
+        bound = self.span_log.closed.maxlen or 512
+        while len(self._shed_order) > bound:
+            self._shed_reasons.pop(self._shed_order.popleft(), None)
+        span = self.span_log.on_shed(req.request_id, now, reason)
+        self._tele(
+            "record_shed",
+            request_id=req.request_id,
+            reason=reason,
+            queue_s=now - req.submit_time,
+            prompt_tokens=len(req.prompt),
+            max_new_tokens=req.max_new_tokens,
+        )
+        if span is not None:
+            self._tele("record_span", **span.to_record())
+
+    def _tele(self, method: str, **fields) -> None:
+        """Emit through the attached telemetry if it has the method —
+        duck-typed/older collectors missing a record_* simply skip it."""
+        if self._telemetry is None:
+            return
+        fn = getattr(self._telemetry, method, None)
+        if fn is not None:
+            fn(**fields)
+
+    def _gauge_fields(self) -> dict:
+        """The live-engine posture sampled into ``kind="serve_gauge"``
+        records (host-side reads only — no device sync)."""
+        now = self._now()
+        sched = self.scheduler
+        ages = [now - r.submit_time for r in sched.queue]
+        pool = self.pool.stats()
+        active = [s for s in sched.slots if s.busy]
+        return {
+            "engine_steps": self._steps,
+            "queue_depth": len(sched.queue),
+            "queue_age_p95_s": percentile(ages, 95) if ages else 0.0,
+            "slots_active": len(active),
+            "slot_occupancy": len(active) / self.max_slots,
+            "pool_blocks_free": pool["free"],
+            "pool_blocks_allocated": pool["allocated"],
+            "pool_utilization": pool["utilization"],
+            "tokens_in_flight": sum(s.cache_len for s in active),
+            "admission_blocked_no_free_slot_total":
+                sched.blocked_reasons["no_free_slot"],
+            "admission_blocked_pool_exhausted_total":
+                sched.blocked_reasons["pool_exhausted"],
+            "shed_queue_full_total": sched.shed_counts["queue_full"],
+            "shed_queue_deadline_total": sched.shed_counts["queue_deadline"],
+        }
+
+    def _sample_gauges(self) -> None:
+        self._tele("record_serve_gauge", **self._gauge_fields())
+
+    def _emit_slo(self) -> None:
+        self._tele("record_slo", **self.slo_tracker.snapshot(self._now()))
+
+    # ------------------------------------------------------------------ #
+    # observability surface
+    # ------------------------------------------------------------------ #
+    def set_observability(
+        self,
+        *,
+        telemetry: Any = None,
+        gauge_interval: int = 1,
+        slo: Any = None,
+        spans: bool = True,
+    ) -> None:
+        """(Re)attach or detach the observability plane at runtime on a
+        WARM engine — the serve bench's A/B toggle: the same compiled
+        programs replay the same trace with observability off, then on,
+        so the measured delta is purely span/gauge/SLO host work.
+        ``slo`` accepts an :class:`SLOConfig` or an existing
+        :class:`SloTracker` (pass the tracker to keep accumulating
+        across toggles)."""
+        self._telemetry = telemetry
+        if gauge_interval < 0:
+            raise ValueError("gauge_interval must be >= 0 (0 disables)")
+        self.gauge_interval = gauge_interval
+        if slo is None:
+            self.slo_tracker = None
+        elif isinstance(slo, SloTracker):
+            self.slo_tracker = slo
+        else:
+            self.slo_tracker = SloTracker(slo)
+        self.span_log.enabled = spans
+
+    def export_trace(self, path: str) -> str:
+        """Write the last ``span_history`` closed spans (plus any still
+        open) as Chrome-trace/Perfetto JSON; returns ``path``. Load in
+        https://ui.perfetto.dev or ``chrome://tracing``."""
+        spans = list(self.span_log.closed) + self.span_log.open_spans
+        return write_chrome_trace(path, spans)
+
+    def start_http(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start the stdlib scrape endpoint (``/metrics`` Prometheus
+        text, ``/healthz``, ``/debug/state`` = :meth:`summary` JSON) on
+        a background thread; returns the exporter (``.port`` carries the
+        bound port when ``port=0``). Requires an attached telemetry with
+        a :class:`~..telemetry.sinks.PrometheusTextSink` for /metrics —
+        one is added in-memory if missing."""
+        if self._http is not None:
+            return self._http
+        from ..telemetry.http_exporter import MetricsHTTPExporter
+        from ..telemetry.sinks import PrometheusTextSink
+
+        metrics_fn = None
+        tele = self._telemetry
+        if tele is not None:
+            sinks = getattr(tele, "sinks", None) or []
+            prom = next(
+                (s for s in sinks if isinstance(s, PrometheusTextSink)), None
+            )
+            if prom is None and hasattr(tele, "add_sink"):
+                prom = PrometheusTextSink(path=None)
+                tele.add_sink(prom)
+            if prom is not None:
+                metrics_fn = prom.render
+        self._http = MetricsHTTPExporter(
+            metrics_fn=metrics_fn, state_fn=self.summary,
+            host=host, port=port,
+        )
+        self._http.start()
+        return self._http
+
+    def stop_http(self) -> None:
+        """Shut the scrape endpoint down cleanly (idempotent)."""
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+
     def summary(self) -> dict:
         """Aggregate serve metrics: the :class:`ServeStats` percentile
-        block plus live pool occupancy and compile counts."""
-        return {
+        block plus live pool/queue/slot posture, span counts, SLO
+        attainment and compile counts."""
+        out = {
             **self.stats.summary(),
             "pool": self.pool.stats(),
             "traces": self.trace_counts(),
+            "gauges": self._gauge_fields(),
+            "spans": self.span_log.summary(),
         }
+        if self.slo_tracker is not None:
+            out["slo"] = self.slo_tracker.snapshot(self._now())
+        return out

@@ -13,6 +13,16 @@ deliberately conservative — an admitted request can never OOM
 mid-flight, so there is no preemption/swap path to get wrong. The cost
 is queueing earlier than an on-demand-growth scheduler would; for
 bounded ``max_new_tokens`` serving that is the right trade.
+
+Overload is observable, not silent: the queue is bounded. ``max_queue``
+tail-drops submissions beyond the bound (``shed_reason="queue_full"``)
+and ``max_queue_delay_s`` sheds queue-head requests whose wait exceeds
+the deadline (``shed_reason="queue_deadline"`` via :meth:`shed_expired`)
+— a request a client would have abandoned anyway should not consume
+slots. Every shed is counted (``shed_counts``), and :meth:`admit`
+attributes WHY admission stalls (``blocked_reasons``: ``no_free_slot``
+vs ``pool_exhausted``) so the gauges can tell "batch full" apart from
+"KV pool exhausted".
 """
 
 from __future__ import annotations
@@ -40,6 +50,9 @@ class Request:
     eos_token_id: Optional[int] = None
     request_id: str = ""
     submit_time: float = 0.0
+    # set when the scheduler refuses/evicts the request instead of
+    # queueing it: "queue_full" | "queue_deadline"
+    shed_reason: Optional[str] = None
 
     def __post_init__(self):
         if not self.request_id:
@@ -90,13 +103,23 @@ class ContinuousScheduler:
         max_slots: int,
         pool: BlockPool,
         now: Callable[[], float] = time.monotonic,
+        max_queue: Optional[int] = None,
+        max_queue_delay_s: Optional[float] = None,
     ):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None for unbounded)")
+        if max_queue_delay_s is not None and max_queue_delay_s <= 0:
+            raise ValueError("max_queue_delay_s must be > 0 (or None)")
         self.slots = [Slot(i) for i in range(max_slots)]
         self.pool = pool
         self.queue: deque[Request] = deque()
         self._now = now
+        self.max_queue = max_queue
+        self.max_queue_delay_s = max_queue_delay_s
+        self.shed_counts = {"queue_full": 0, "queue_deadline": 0}
+        self.blocked_reasons = {"no_free_slot": 0, "pool_exhausted": 0}
         max_tokens = (pool.num_blocks - 1) * pool.block_size
         self.max_request_tokens = max_tokens
 
@@ -112,8 +135,33 @@ class ContinuousScheduler:
                 f"{self.pool.num_blocks - 1} allocatable blocks total"
             )
         request.submit_time = self._now()
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            # tail-drop: the newest request is the one refused (FIFO
+            # fairness — those already waiting keep their place)
+            request.shed_reason = "queue_full"
+            self.shed_counts["queue_full"] += 1
+            return request.request_id
         self.queue.append(request)
         return request.request_id
+
+    def shed_expired(self) -> list[Request]:
+        """Shed queue-head requests whose wait exceeds
+        ``max_queue_delay_s``. FIFO means the head is always the oldest,
+        so the scan stops at the first fresh-enough request. Called by
+        the engine once per step, before admission."""
+        if self.max_queue_delay_s is None:
+            return []
+        now = self._now()
+        shed: list[Request] = []
+        while self.queue:
+            req = self.queue[0]
+            if now - req.submit_time <= self.max_queue_delay_s:
+                break
+            self.queue.popleft()
+            req.shed_reason = "queue_deadline"
+            self.shed_counts["queue_deadline"] += 1
+            shed.append(req)
+        return shed
 
     def release(self, slot: Slot) -> None:
         """Return a finished slot's blocks and empty the seat — the very
@@ -131,12 +179,16 @@ class ContinuousScheduler:
         while self.queue:
             slot = next(free_slots, None)
             if slot is None:
+                # queue non-empty but the decode batch is full
+                self.blocked_reasons["no_free_slot"] += 1
                 break
             req = self.queue[0]
             need = self.pool.blocks_for_tokens(
                 len(req.prompt) + req.max_new_tokens
             )
             if not self.pool.can_allocate(need):
+                # a seat is free but the KV pool can't fund the head
+                self.blocked_reasons["pool_exhausted"] += 1
                 break
             self.queue.popleft()
             slot.clear()

@@ -14,6 +14,7 @@ any jitted function — see :class:`StepTelemetry`.
 from .collector import StepTelemetry
 from .config import TelemetryConfig
 from .heartbeat import HeartbeatMonitor, scan_heartbeats
+from .http_exporter import MetricsHTTPExporter
 from .recompile import RecompileDetector, tree_fingerprint
 from .sinks import (
     SCHEMA_VERSION,
@@ -27,6 +28,7 @@ __all__ = [
     "StepTelemetry",
     "TelemetryConfig",
     "HeartbeatMonitor",
+    "MetricsHTTPExporter",
     "scan_heartbeats",
     "RecompileDetector",
     "tree_fingerprint",

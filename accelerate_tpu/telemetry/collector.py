@@ -443,6 +443,63 @@ class StepTelemetry:
         self._emit(record)
         return record
 
+    def _record_event(
+        self, kind: str, label: str, fields: dict
+    ) -> Optional[dict]:
+        """Shared shape for the serving-observability record kinds: flat
+        record, ``time_unix`` stamp, the normal :meth:`_emit` path (ring,
+        sinks, diagnostics). None while disabled."""
+        if not self.enabled:
+            return None
+        record: dict[str, Any] = {
+            "kind": kind,
+            "label": label,
+            "time_unix": time.time(),
+        }
+        for key, value in fields.items():
+            record.setdefault(key, value)
+        self._emit(record)
+        return record
+
+    def record_span(self, *, label: str = "serve", **fields) -> Optional[dict]:
+        """Emit a ``kind="span"`` record — one request's full lifecycle
+        timestamps (submit/admit/prefill/first-token/finish plus derived
+        phase durations), emitted by the ServingEngine at the terminal
+        transition (finished OR shed). Rings into the flight recorder
+        like every record, so the last N spans survive a SIGKILL."""
+        return self._record_event("span", label, fields)
+
+    def record_serve_gauge(
+        self, *, label: str = "serve", **fields
+    ) -> Optional[dict]:
+        """Emit a ``kind="serve_gauge"`` record — a point-in-time sample
+        of live engine posture (queue depth/age, slot occupancy, pool
+        utilization, tokens in flight, blocked/shed counters). The
+        Prometheus sink exports each field as a gauge."""
+        return self._record_event("serve_gauge", label, fields)
+
+    def record_shed(
+        self,
+        *,
+        request_id: str,
+        reason: str,
+        label: str = "serve",
+        **fields,
+    ) -> Optional[dict]:
+        """Emit a ``kind="shed"`` record — one request REFUSED or evicted
+        under overload (``reason``: ``queue_full`` | ``queue_deadline``).
+        The Prometheus sink counts these per reason."""
+        return self._record_event(
+            "shed", label, {"request_id": request_id, "reason": reason, **fields}
+        )
+
+    def record_slo(self, *, label: str = "serve", **fields) -> Optional[dict]:
+        """Emit a ``kind="slo"`` record — attainment + multi-window burn
+        rates for the serving latency objectives. Records with
+        ``breach=True`` are routed to the anomaly detector by
+        diagnostics (they can trigger profile captures)."""
+        return self._record_event("slo", label, fields)
+
     # ------------------------------------------------------------------ #
     # reporting / lifecycle
     # ------------------------------------------------------------------ #

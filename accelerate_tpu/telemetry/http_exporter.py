@@ -1,0 +1,144 @@
+"""Stdlib-only scrape endpoint for live telemetry.
+
+The JSONL/Prometheus-textfile sinks assume someone can read the pod's
+filesystem; a LIVE engine needs a port. :class:`MetricsHTTPExporter` is
+an ``http.server`` on a daemon thread — no client library, no asyncio —
+serving three routes:
+
+* ``/metrics`` — Prometheus text exposition (``metrics_fn``, typically a
+  :class:`~.sinks.PrometheusTextSink`'s ``render``) for a Prometheus
+  scraper or a human with curl;
+* ``/healthz`` — liveness JSON for k8s probes (``health_fn`` optional:
+  return a falsy value to report 503, e.g. "engine thread died");
+* ``/debug/state`` — full state JSON (``state_fn``, typically
+  ``ServingEngine.summary``) for incident forensics.
+
+``port=0`` binds an ephemeral port (tests; ``.port`` carries the real
+one after :meth:`start`). Callbacks run on the serving thread — they
+must be cheap, host-side reads (both defaults are). Exceptions in a
+callback become a 500 on that scrape, never an engine crash.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class MetricsHTTPExporter:
+    """Background-thread HTTP server exposing /metrics, /healthz and
+    /debug/state. ``start()`` returns self; ``stop()`` shuts the server
+    down cleanly and joins the thread (idempotent)."""
+
+    def __init__(
+        self,
+        metrics_fn: Optional[Callable[[], str]] = None,
+        state_fn: Optional[Callable[[], Any]] = None,
+        health_fn: Optional[Callable[[], Any]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.metrics_fn = metrics_fn
+        self.state_fn = state_fn
+        self.health_fn = health_fn
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ #
+    def start(self) -> "MetricsHTTPExporter":
+        if self._server is not None:
+            return self
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # no stderr spam per scrape
+                pass
+
+            def _send(self, code: int, content_type: str, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        text = (
+                            exporter.metrics_fn()
+                            if exporter.metrics_fn is not None
+                            else ""
+                        )
+                        self._send(
+                            200,
+                            "text/plain; version=0.0.4; charset=utf-8",
+                            text.encode(),
+                        )
+                    elif path == "/healthz":
+                        ok = (
+                            exporter.health_fn()
+                            if exporter.health_fn is not None
+                            else True
+                        )
+                        body = json.dumps({"ok": bool(ok)}).encode()
+                        self._send(
+                            200 if ok else 503, "application/json", body
+                        )
+                    elif path == "/debug/state":
+                        state = (
+                            exporter.state_fn()
+                            if exporter.state_fn is not None
+                            else {}
+                        )
+                        body = json.dumps(state, default=str).encode()
+                        self._send(200, "application/json", body)
+                    else:
+                        self._send(404, "text/plain", b"not found\n")
+                except Exception as exc:  # a bad callback 500s ONE scrape
+                    try:
+                        self._send(
+                            500, "text/plain", f"error: {exc}\n".encode()
+                        )
+                    except Exception:
+                        pass  # client hung up mid-error
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]  # real port when 0
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="metrics-http-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info(f"metrics endpoint on http://{self.host}:{self.port}/metrics")
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._server = None
+        self._thread = None
+
+    def __enter__(self) -> "MetricsHTTPExporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -90,6 +90,76 @@ ServingEngine at slot retirement)::
     rolling-window p50/p95/p99 quantile lines plus cumulative _count and
     _sum — instead of last-value gauges.
 
+``kind="span"`` (one per request reaching a TERMINAL state — finished or
+shed; emitted by the ServingEngine's span log)::
+
+    request_id       str    the request
+    state            str    "finished" | "shed"
+    shed_reason      str?   "queue_full" | "queue_deadline" when shed
+    prompt_tokens    int    prompt length
+    new_tokens       int    tokens generated (0 for shed requests)
+    submit_t         float  engine-clock (monotonic) lifecycle stamps;
+    admit_t          float? null where the span never reached the edge
+    prefill_start_t  float?
+    first_token_t    float?
+    finish_t         float  terminal stamp (finish or shed instant)
+    queue_s          float? derived: admit - submit
+    prefill_s        float? derived: first_token - prefill_start
+    decode_s         float? derived: finish - first_token
+    e2e_s            float? derived: finish - submit
+
+    Invariant: submit_t <= admit_t <= prefill_start_t <= first_token_t
+    <= finish_t for finished spans. ``ServingEngine.export_trace(path)``
+    renders the span ring as Chrome-trace/Perfetto JSON.
+
+``kind="serve_gauge"`` (live engine posture, sampled every
+``gauge_interval`` engine steps; each field becomes a Prometheus gauge
+``{prefix}_serve_{field}``)::
+
+    engine_steps                         int    step() calls so far
+    queue_depth                          int    requests waiting
+    queue_age_p95_s                      float  p95 wait of QUEUED requests
+    slots_active                         int    busy decode seats
+    slot_occupancy                       float  slots_active / max_slots
+    pool_blocks_free                     int    KV pool posture
+    pool_blocks_allocated                int
+    pool_utilization                     float
+    tokens_in_flight                     int    KV tokens held by active slots
+    admission_blocked_no_free_slot_total  int   admit() stalls: batch full
+    admission_blocked_pool_exhausted_total int  admit() stalls: pool empty
+    shed_queue_full_total                int    cumulative sheds per reason
+    shed_queue_deadline_total            int
+
+``kind="shed"`` (one per request refused/evicted under overload; the
+Prometheus sink counts these as
+``{prefix}_serve_shed_total{reason="..."}``)::
+
+    request_id      str    the refused request
+    reason          str    "queue_full" (tail-dropped at max_queue) |
+                           "queue_deadline" (waited > max_queue_delay_s)
+    queue_s         float  how long it waited before shedding
+    prompt_tokens   int    what was refused (capacity forensics)
+    max_new_tokens  int
+
+``kind="slo"`` (every ``SLOConfig.interval_steps`` engine steps;
+numeric fields become ``{prefix}_slo_{field}`` gauges)::
+
+    target               float  required attainment fraction (e.g. 0.99)
+    ttft_objective_s     float  the latency objectives
+    e2e_objective_s      float
+    requests_total       int    lifetime finished requests
+    requests_fast_window int    requests inside each burn window
+    requests_slow_window int
+    {ttft,e2e}_attainment        float? lifetime fraction meeting objective
+    {ttft,e2e}_attainment_window float? over the slow window
+    {ttft,e2e}_burn_fast         float  error_rate / (1 - target) per window
+    {ttft,e2e}_burn_slow         float  (1.0 = burning budget exactly at
+                                        the sustainable rate)
+    max_burn_rate        float  worst burn across objectives/windows
+    breach               bool   fast AND slow burn >= threshold for some
+                                objective (routed to the anomaly detector)
+    breached_objectives  list   which objectives breached
+
 ``kind="goodput"`` (every ``goodput_interval`` steps when diagnostics is
 on; the wall-clock attribution fold)::
 
@@ -217,20 +287,27 @@ class PrometheusTextSink(TelemetrySink):
     """Latest-value gauges in Prometheus text exposition format, written
     atomically to ``path`` on every record — point node_exporter's
     textfile collector (or a sidecar cat) at it. No client library, no
-    daemon: the step loop is the exporter."""
+    daemon: the step loop is the exporter.
+
+    ``path=None`` keeps the sink in-memory only: :meth:`render` returns
+    the current exposition text (what the HTTP ``/metrics`` endpoint
+    serves) without ever touching disk."""
 
     def __init__(
         self,
-        path: Union[str, os.PathLike],
+        path: Optional[Union[str, os.PathLike]] = None,
         prefix: str = "accelerate_tpu",
         summary_window: int = 1024,
     ):
-        self.path = os.fspath(path)
+        self.path = os.fspath(path) if path is not None else None
         self.prefix = prefix
-        parent = os.path.dirname(self.path)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
+        if self.path:
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
         self._gauges: dict[tuple[str, str], float] = {}  # (metric, label) -> value
+        # (metric, label_name, label_value) -> monotonic count
+        self._counters: dict[tuple[str, str, str], float] = {}
         # (metric, label) -> rolling observation window for quantiles;
         # _count/_sum stay cumulative (Prometheus summary semantics)
         self._summary_window = int(summary_window)
@@ -243,6 +320,20 @@ class PrometheusTextSink(TelemetrySink):
         if kind == "serve":
             self._emit_serve(record)
             return
+        if kind == "serve_gauge":
+            self._emit_prefixed_gauges(record, "serve")
+            return
+        if kind == "slo":
+            self._emit_slo(record)
+            return
+        if kind == "shed":
+            reason = str(record.get("reason", "unknown"))
+            key = (f"{self.prefix}_serve_shed_total", "reason", reason)
+            self._counters[key] = self._counters.get(key, 0.0) + 1.0
+            self._write()
+            return
+        if kind == "span":
+            return  # per-request traces belong in JSONL/Perfetto, not gauges
         if kind not in (None, "step", "goodput"):
             return
         label = str(record.get("label", "step"))
@@ -253,6 +344,30 @@ class PrometheusTextSink(TelemetrySink):
             if name is None:
                 continue
             self._gauges[(f"{self.prefix}_{name}", label)] = float(value)
+        self._write()
+
+    def _emit_prefixed_gauges(self, record: dict, section: str) -> None:
+        label = str(record.get("label", "serve"))
+        for key, value in record.items():
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            if _PROM_RENAMES.get(key, key) is None:
+                continue
+            self._gauges[
+                (f"{self.prefix}_{section}_{key}", label)
+            ] = float(value)
+        self._write()
+
+    def _emit_slo(self, record: dict) -> None:
+        label = str(record.get("label", "serve"))
+        for key, value in record.items():
+            if key == "breach":  # the one bool worth a gauge (0/1 alert line)
+                value = 1.0 if value else 0.0
+            elif isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            if _PROM_RENAMES.get(key, key) is None:
+                continue
+            self._gauges[(f"{self.prefix}_slo_{key}", label)] = float(value)
         self._write()
 
     def _emit_serve(self, record: dict) -> None:
@@ -285,7 +400,9 @@ class PrometheusTextSink(TelemetrySink):
             value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
         )
 
-    def _write(self) -> None:
+    def render(self) -> str:
+        """The full exposition text (what ``/metrics`` serves and what
+        ``_write`` puts on disk)."""
         lines = []
         for metric in sorted({m for m, _ in self._gauges}):
             lines.append(f"# TYPE {metric} gauge")
@@ -293,6 +410,12 @@ class PrometheusTextSink(TelemetrySink):
                 if m == metric:
                     escaped = self._escape_label(label)
                     lines.append(f'{metric}{{label="{escaped}"}} {value}')
+        for metric in sorted({m for m, _, _ in self._counters}):
+            lines.append(f"# TYPE {metric} counter")
+            for (m, lname, lvalue), value in sorted(self._counters.items()):
+                if m == metric:
+                    escaped = self._escape_label(lvalue)
+                    lines.append(f'{metric}{{{lname}="{escaped}"}} {value}')
         for metric in sorted({m for m, _ in self._summaries}):
             lines.append(f"# TYPE {metric} summary")
             for (m, label), window in sorted(self._summaries.items()):
@@ -313,13 +436,18 @@ class PrometheusTextSink(TelemetrySink):
                     f'{metric}_sum{{label="{escaped}"}} '
                     f"{self._summary_sums[(m, label)]}"
                 )
+        return "\n".join(lines) + "\n"
+
+    def _write(self) -> None:
+        if self.path is None:
+            return
         tmp = f"{self.path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            f.write("\n".join(lines) + "\n")
+            f.write(self.render())
         os.replace(tmp, self.path)  # scrapers never see a torn file
 
     def close(self) -> None:
-        if self._gauges or self._summaries:
+        if self._gauges or self._counters or self._summaries:
             self._write()
 
 

@@ -1,0 +1,653 @@
+"""Serving observability plane tests: spans, gauges, SLO burn-rate,
+scrape endpoint, and observable overload shedding.
+
+Three layers again, matching the subsystem split: pure host arithmetic
+first (fake-clock scheduler shedding, burn-rate windows, span ordering,
+Prometheus text — no jax), then the engine wiring (records actually
+flow, zero-retrace preserved, bounded memory), then the end-to-end
+overload smoke (slow-marked: engine under synthetic overload → live
+/metrics scrape → flight dump → `diagnose` names shed counts and SLO
+attainment).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.serving import (
+    BlockPool,
+    ContinuousScheduler,
+    Request,
+    SLOConfig,
+    SloTracker,
+    SpanLog,
+    spans_to_chrome_trace,
+    write_chrome_trace,
+)
+from accelerate_tpu.serving.telemetry import ServeStats
+from accelerate_tpu.telemetry import MetricsHTTPExporter, PrometheusTextSink
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+# --------------------------------------------------------------------- #
+# scheduler shedding (pure host, fake clock)
+# --------------------------------------------------------------------- #
+class TestSchedulerShedding:
+    def _sched(self, clock, **kw):
+        pool = BlockPool(num_blocks=9, block_size=8)
+        return ContinuousScheduler(2, pool, now=clock, **kw)
+
+    def test_queue_bound_tail_drops(self):
+        clock = FakeClock()
+        sched = self._sched(clock, max_queue=2)
+        reqs = [Request(prompt=[1, 2], max_new_tokens=4) for _ in range(4)]
+        for r in reqs:
+            sched.submit(r)
+        # first two queued, the rest tail-dropped with a reason
+        assert len(sched.queue) == 2
+        assert [r.shed_reason for r in reqs] == [
+            None, None, "queue_full", "queue_full",
+        ]
+        assert sched.shed_counts["queue_full"] == 2
+        # those waiting kept their place (FIFO fairness)
+        assert list(sched.queue) == reqs[:2]
+
+    def test_queue_deadline_sheds_expired_head(self):
+        clock = FakeClock()
+        sched = self._sched(clock, max_queue_delay_s=5.0)
+        old = Request(prompt=[1], max_new_tokens=2)
+        sched.submit(old)
+        clock.tick(4.0)
+        fresh = Request(prompt=[2], max_new_tokens=2)
+        sched.submit(fresh)
+        assert sched.shed_expired() == []  # nothing expired yet
+        clock.tick(2.0)  # old is 6s deep, fresh only 2s
+        shed = sched.shed_expired()
+        assert [r.request_id for r in shed] == [old.request_id]
+        assert old.shed_reason == "queue_deadline"
+        assert list(sched.queue) == [fresh]
+        assert sched.shed_counts["queue_deadline"] == 1
+
+    def test_admit_attributes_blocked_reason(self):
+        clock = FakeClock()
+        pool = BlockPool(num_blocks=9, block_size=8)
+        sched = ContinuousScheduler(1, pool, now=clock)
+        # one slot: second queued request blocks on no_free_slot
+        for _ in range(2):
+            sched.submit(Request(prompt=[1] * 4, max_new_tokens=4))
+        sched.admit()
+        assert sched.blocked_reasons["no_free_slot"] == 1
+        assert sched.blocked_reasons["pool_exhausted"] == 0
+        # big request on a 2-slot scheduler: a seat is free but the pool
+        # can't fund it -> pool_exhausted
+        sched2 = ContinuousScheduler(2, pool, now=clock)
+        sched2.submit(Request(prompt=[1] * 30, max_new_tokens=30))
+        sched2.admit()
+        assert sched2.blocked_reasons["pool_exhausted"] == 1
+
+    def test_unbounded_by_default(self):
+        clock = FakeClock()
+        sched = self._sched(clock)
+        for _ in range(100):
+            sched.submit(Request(prompt=[1], max_new_tokens=2))
+        assert len(sched.queue) == 100
+        assert sched.shed_expired() == []
+
+
+# --------------------------------------------------------------------- #
+# SLO multi-window burn-rate arithmetic (fake clock)
+# --------------------------------------------------------------------- #
+class TestSloTracker:
+    CFG = dict(
+        ttft_objective_s=0.1, e2e_objective_s=1.0, target=0.9,
+        fast_window_s=10.0, slow_window_s=100.0, burn_threshold=1.0,
+        min_requests=2,
+    )
+
+    def test_burn_rate_arithmetic(self):
+        t = SloTracker(SLOConfig(**self.CFG))
+        # 10 requests, 2 miss ttft -> error rate 0.2, budget 0.1 -> burn 2.0
+        for i in range(10):
+            ttft = 0.5 if i < 2 else 0.05
+            t.observe(float(i), ttft, 0.5)
+        snap = t.snapshot(9.0)
+        assert snap["ttft_burn_fast"] == pytest.approx(2.0)
+        assert snap["ttft_burn_slow"] == pytest.approx(2.0)
+        assert snap["e2e_burn_fast"] == 0.0
+        assert snap["ttft_attainment"] == pytest.approx(0.8)
+        assert snap["breach"] and snap["breached_objectives"] == ["ttft"]
+
+    def test_multi_window_and_gate(self):
+        # a burst of misses burns the fast window but not the slow one:
+        # multi-window AND must hold the alarm
+        t = SloTracker(SLOConfig(**self.CFG))
+        for i in range(90):  # long healthy history
+            t.observe(float(i), 0.05, 0.5)
+        for i in range(3):  # short burst of ttft misses at the end
+            t.observe(90.0 + i, 0.5, 0.5)
+        snap = t.snapshot(93.0)
+        assert snap["ttft_burn_fast"] >= 1.0  # fast window is burning
+        assert snap["ttft_burn_slow"] < 1.0   # diluted over the slow window
+        assert not snap["breach"]
+
+    def test_min_requests_gate(self):
+        t = SloTracker(SLOConfig(**self.CFG))
+        t.observe(0.0, 99.0, 99.0)  # one total miss
+        snap = t.snapshot(0.0)
+        assert snap["ttft_burn_fast"] > 1.0
+        assert not snap["breach"]  # 1 request < min_requests
+
+    def test_events_age_out_lifetime_persists(self):
+        t = SloTracker(SLOConfig(**self.CFG))
+        for i in range(5):
+            t.observe(float(i), 99.0, 99.0)  # all miss
+        snap = t.snapshot(500.0)  # far beyond the slow window
+        assert snap["requests_slow_window"] == 0
+        assert snap["ttft_burn_slow"] == 0.0
+        assert snap["requests_total"] == 5
+        assert snap["ttft_attainment"] == 0.0  # lifetime remembers
+
+    def test_none_latency_counts_as_miss(self):
+        t = SloTracker(SLOConfig(**self.CFG))
+        t.observe(0.0, None, None)
+        assert t.met_total == {"ttft": 0, "e2e": 0}
+
+
+# --------------------------------------------------------------------- #
+# spans: ordering invariant + Perfetto round-trip
+# --------------------------------------------------------------------- #
+class TestSpans:
+    def _finished_span(self, log, rid="r0"):
+        log.on_submit(rid, 1.0, prompt_tokens=4)
+        log.on_admit(rid, 2.0)
+        log.on_prefill(rid, 2.5)
+        log.on_first_token(rid, 3.0)
+        return log.on_finish(rid, 5.0, new_tokens=8)
+
+    def test_ordering_invariant_and_durations(self):
+        log = SpanLog()
+        span = self._finished_span(log)
+        assert (
+            span.submit_t <= span.admit_t <= span.prefill_start_t
+            <= span.first_token_t <= span.finish_t
+        )
+        rec = span.to_record()
+        assert rec["queue_s"] == pytest.approx(1.0)
+        assert rec["prefill_s"] == pytest.approx(0.5)
+        assert rec["decode_s"] == pytest.approx(2.0)
+        assert rec["e2e_s"] == pytest.approx(4.0)
+        assert rec["state"] == "finished"
+
+    def test_shed_span_is_terminal_with_reason(self):
+        log = SpanLog()
+        log.on_submit("r1", 1.0)
+        span = log.on_shed("r1", 3.0, "queue_full")
+        assert span.terminal and span.state == "shed"
+        rec = span.to_record()
+        assert rec["shed_reason"] == "queue_full"
+        assert rec["first_token_t"] is None and rec["decode_s"] is None
+        assert rec["e2e_s"] == pytest.approx(2.0)  # time in system pre-shed
+        assert log.summary()["spans_shed"] == 1
+
+    def test_ring_bounds_closed_spans(self):
+        log = SpanLog(maxlen=3)
+        for i in range(6):
+            log.on_submit(f"r{i}", float(i))
+            log.on_finish(f"r{i}", float(i) + 1.0, 1)
+        assert len(log.closed) == 3
+        assert [s.request_id for s in log.closed] == ["r3", "r4", "r5"]
+
+    def test_perfetto_round_trip(self, tmp_path):
+        log = SpanLog()
+        self._finished_span(log, "good")
+        log.on_submit("bad", 1.5)
+        log.on_shed("bad", 4.0, "queue_deadline")
+        path = str(tmp_path / "trace.json")
+        write_chrome_trace(path, log.closed)
+        with open(path) as f:
+            payload = json.load(f)
+        events = payload["traceEvents"]
+        slices = [e for e in events if e["ph"] == "X"]
+        names = {e["name"] for e in slices}
+        assert {"queue", "prefill", "decode", "shed:queue_deadline"} <= names
+        # Chrome-trace contract: complete events carry non-negative
+        # microsecond ts/dur, and metadata names the request rows
+        assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in slices)
+        metas = [e for e in events if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in metas} == {"good", "bad"}
+        queue = next(e for e in slices if e["name"] == "queue")
+        assert queue["dur"] == pytest.approx(1.0 * 1e6)
+
+    def test_chrome_trace_time_origin(self):
+        log = SpanLog()
+        self._finished_span(log)
+        payload = spans_to_chrome_trace(log.closed)
+        first = min(
+            e["ts"] for e in payload["traceEvents"] if e["ph"] == "X"
+        )
+        assert first == 0.0  # traces start at the earliest submit
+
+
+# --------------------------------------------------------------------- #
+# bounded ServeStats (the unbounded-memory satellite)
+# --------------------------------------------------------------------- #
+class TestServeStatsBounded:
+    def test_window_bounds_percentiles_totals_cumulative(self):
+        stats = ServeStats(window=4)
+        for i in range(10):
+            stats.add({"prompt_tokens": 1, "new_tokens": 2, "ttft_s": float(i)})
+        assert len(stats.requests) == 4  # window
+        s = stats.summary()
+        assert s["requests"] == 10  # lifetime counter survives eviction
+        assert s["new_tokens"] == 20
+        assert s["ttft_s_p50"] == pytest.approx(7.5)  # over [6, 7, 8, 9]
+        assert len(stats) == 10
+
+    def test_shed_counts_in_summary(self):
+        stats = ServeStats()
+        stats.add_shed("queue_full")
+        stats.add_shed("queue_full")
+        stats.add_shed("queue_deadline")
+        s = stats.summary()
+        assert s["shed_total"] == 3
+        assert s["shed_queue_full"] == 2
+        assert s["shed_queue_deadline"] == 1
+
+
+# --------------------------------------------------------------------- #
+# Prometheus sink: new kinds + render()
+# --------------------------------------------------------------------- #
+class TestPrometheusServingKinds:
+    def test_gauge_shed_slo_lines(self):
+        sink = PrometheusTextSink(path=None)  # in-memory only
+        sink.emit({"kind": "serve_gauge", "label": "serve",
+                   "queue_depth": 7, "slot_occupancy": 0.75, "time_unix": 1.0})
+        sink.emit({"kind": "shed", "reason": "queue_full", "request_id": "r"})
+        sink.emit({"kind": "shed", "reason": "queue_full", "request_id": "r2"})
+        sink.emit({"kind": "slo", "breach": True, "max_burn_rate": 3.5,
+                   "breached_objectives": ["ttft"], "time_unix": 1.0})
+        text = sink.render()
+        assert 'accelerate_tpu_serve_queue_depth{label="serve"} 7.0' in text
+        assert "# TYPE accelerate_tpu_serve_shed_total counter" in text
+        assert 'accelerate_tpu_serve_shed_total{reason="queue_full"} 2.0' in text
+        assert 'accelerate_tpu_slo_breach{label="serve"} 1.0' in text
+        assert "accelerate_tpu_slo_max_burn_rate" in text
+        # non-numeric fields (the objectives list) never leak into lines
+        assert "breached_objectives" not in text
+
+    def test_span_records_are_not_gauges(self):
+        sink = PrometheusTextSink(path=None)
+        sink.emit({"kind": "span", "request_id": "r", "submit_t": 1.0})
+        assert sink.render() == "\n"
+
+    def test_path_none_never_touches_disk(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        sink = PrometheusTextSink(path=None)
+        sink.emit({"kind": "serve_gauge", "queue_depth": 1})
+        sink.close()
+        assert list(tmp_path.iterdir()) == []
+
+
+# --------------------------------------------------------------------- #
+# HTTP exporter: ephemeral-port scrape
+# --------------------------------------------------------------------- #
+class TestHTTPExporter:
+    def test_scrape_metrics_healthz_state(self):
+        sink = PrometheusTextSink(path=None)
+        # label escaping must survive the full render->HTTP round trip
+        sink.emit({"kind": "serve_gauge", "label": 'we"ird\\lab\nel',
+                   "queue_depth": 3})
+        ex = MetricsHTTPExporter(
+            metrics_fn=sink.render,
+            state_fn=lambda: {"requests": 5},
+            port=0,  # ephemeral: parallel tests can't collide
+        )
+        with ex:
+            assert ex.port != 0
+            base = f"http://127.0.0.1:{ex.port}"
+            body = urllib.request.urlopen(f"{base}/metrics", timeout=5)
+            assert body.headers["Content-Type"].startswith("text/plain")
+            text = body.read().decode()
+            assert (
+                'accelerate_tpu_serve_queue_depth{label="we\\"ird\\\\lab\\nel"} 3.0'
+                in text
+            )
+            health = json.loads(
+                urllib.request.urlopen(f"{base}/healthz", timeout=5).read()
+            )
+            assert health == {"ok": True}
+            state = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/debug/state", timeout=5
+                ).read()
+            )
+            assert state == {"requests": 5}
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"{base}/nope", timeout=5)
+            assert err.value.code == 404
+
+    def test_failing_callback_is_a_500_not_a_crash(self):
+        def boom():
+            raise RuntimeError("sink exploded")
+
+        with MetricsHTTPExporter(metrics_fn=boom, port=0) as ex:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{ex.port}/metrics", timeout=5
+                )
+            assert err.value.code == 500
+            # server survives: next route still answers
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{ex.port}/healthz", timeout=5
+            )
+            assert health.status == 200
+
+    def test_stop_is_idempotent(self):
+        ex = MetricsHTTPExporter(port=0).start()
+        ex.stop()
+        ex.stop()
+
+
+# --------------------------------------------------------------------- #
+# engine wiring (jax; tiny model)
+# --------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    return cfg, model, params
+
+
+def _overloaded_engine(tiny_model, telemetry=None, **kw):
+    from accelerate_tpu.serving import ServingEngine
+
+    _, model, params = tiny_model
+    clock = FakeClock()
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 8)
+    engine = ServingEngine(
+        model, params, telemetry=telemetry, now=clock, **kw
+    )
+    return engine, clock
+
+
+class TestEngineObservability:
+    def test_engine_sheds_on_queue_bound_with_terminal_span(self, tiny_model):
+        engine, _ = _overloaded_engine(tiny_model, max_queue=2)
+        rng = np.random.default_rng(0)
+        rids = [
+            engine.add_request(rng.integers(1, 50, size=4), max_new_tokens=4)
+            for _ in range(6)
+        ]
+        # admission happens on step(), so only max_queue=2 requests fit
+        # at submit time; the other 4 tail-drop immediately
+        shed = [r for r in rids if engine.shed_reason(r) == "queue_full"]
+        assert len(shed) == 4
+        for _ in engine.stream():
+            pass
+        # every request is terminal: finished with a result or shed
+        for rid in rids:
+            assert (engine.result(rid) is not None) ^ (
+                engine.shed_reason(rid) is not None
+            )
+        assert engine.summary()["shed_queue_full"] == 4
+        spans = {s.request_id: s for s in engine.span_log.closed}
+        assert all(spans[r].state == "shed" for r in shed)
+        assert engine.trace_counts()["decode"] == 1  # zero-retrace holds
+
+    def test_engine_sheds_on_queue_deadline(self, tiny_model):
+        engine, clock = _overloaded_engine(
+            tiny_model, max_slots=1, max_queue_delay_s=0.5
+        )
+        rng = np.random.default_rng(1)
+        rids = [
+            engine.add_request(rng.integers(1, 50, size=4), max_new_tokens=8)
+            for _ in range(3)
+        ]
+        engine.step()  # admits rid0; rid1/rid2 wait
+        clock.tick(1.0)  # both queued requests blow the 0.5s deadline
+        engine.step()
+        assert engine.shed_reason(rids[1]) == "queue_deadline"
+        assert engine.shed_reason(rids[2]) == "queue_deadline"
+        for _ in engine.stream():
+            pass
+        assert engine.result(rids[0]) is not None
+        assert engine.summary()["shed_queue_deadline"] == 2
+
+    def test_records_flow_and_span_ordering(self, tiny_model):
+        from accelerate_tpu.serving import SLOConfig
+        from accelerate_tpu.telemetry import StepTelemetry
+
+        tel = StepTelemetry(True)
+        engine, _ = _overloaded_engine(
+            tiny_model, telemetry=tel,
+            slo=SLOConfig(interval_steps=2, min_requests=1),
+            gauge_interval=1,
+        )
+        rng = np.random.default_rng(2)
+        for _ in range(3):
+            engine.add_request(rng.integers(1, 50, size=5), max_new_tokens=4)
+        for _ in engine.stream():
+            pass
+        kinds = {r.get("kind") for r in tel.records}
+        assert {"serve", "span", "serve_gauge", "slo"} <= kinds
+        for rec in tel.records:
+            if rec.get("kind") != "span":
+                continue
+            assert rec["state"] == "finished"
+            assert (
+                rec["submit_t"] <= rec["admit_t"] <= rec["prefill_start_t"]
+                <= rec["first_token_t"] <= rec["finish_t"]
+            )
+        gauge = next(
+            r for r in tel.records if r.get("kind") == "serve_gauge"
+        )
+        assert {"queue_depth", "slot_occupancy", "pool_utilization",
+                "tokens_in_flight"} <= set(gauge)
+        tel.close()
+
+    def test_result_fifo_eviction(self, tiny_model):
+        engine, _ = _overloaded_engine(tiny_model, max_retained_results=2)
+        rng = np.random.default_rng(3)
+        rids = [
+            engine.add_request(rng.integers(1, 50, size=4), max_new_tokens=2)
+            for _ in range(4)
+        ]
+        for _ in engine.stream():
+            pass
+        retained = [r for r in rids if engine.result(r) is not None]
+        assert len(retained) == 2  # oldest two evicted, newest two kept
+        assert engine.result(rids[0]) is None
+
+    def test_export_trace_after_serving(self, tiny_model, tmp_path):
+        engine, _ = _overloaded_engine(tiny_model)
+        rng = np.random.default_rng(4)
+        engine.add_request(rng.integers(1, 50, size=4), max_new_tokens=3)
+        for _ in engine.stream():
+            pass
+        path = str(tmp_path / "serve_trace.json")
+        engine.export_trace(path)
+        with open(path) as f:
+            payload = json.load(f)
+        assert {e["name"] for e in payload["traceEvents"]
+                if e["ph"] == "X"} >= {"queue", "prefill", "decode"}
+
+    def test_slo_breach_routes_to_anomaly(self, tiny_model, tmp_path):
+        from accelerate_tpu.serving import SLOConfig, ServingEngine
+        from accelerate_tpu.telemetry import StepTelemetry, TelemetryConfig
+
+        tel = StepTelemetry(TelemetryConfig(diagnostics=str(tmp_path)))
+        _, model, params = tiny_model
+        # impossible objective + REAL clock (a frozen fake clock yields
+        # 0s latencies, which trivially meet any objective)
+        engine = ServingEngine(
+            model, params, max_slots=2, block_size=8, telemetry=tel,
+            slo=SLOConfig(
+                ttft_objective_s=1e-9, e2e_objective_s=1e-9,
+                interval_steps=1, min_requests=1,
+            ),
+        )
+        rng = np.random.default_rng(5)
+        for _ in range(2):
+            engine.add_request(rng.integers(1, 50, size=4), max_new_tokens=2)
+        for _ in engine.stream():
+            pass
+        anomalies = [
+            r for r in tel.records if r.get("kind") == "anomaly"
+        ]
+        assert any(a["anomaly_type"] == "slo_breach" for a in anomalies)
+        tel.close()
+
+
+# --------------------------------------------------------------------- #
+# diagnose: the serving section
+# --------------------------------------------------------------------- #
+class TestDiagnoseServing:
+    def _dump(self, tmp_path, records):
+        payload = {
+            "process_index": 0, "reason": "test", "time_unix": 1.0,
+            "dumps": 1, "last_step": None, "records": records, "events": [],
+        }
+        with open(tmp_path / "flightrec-rank0.json", "w") as f:
+            json.dump(payload, f)
+
+    def test_report_names_shed_and_slo(self, tmp_path):
+        from accelerate_tpu.diagnostics import build_report, format_report
+
+        self._dump(tmp_path, [
+            {"kind": "shed", "reason": "queue_full", "request_id": "a"},
+            {"kind": "serve_gauge", "queue_depth": 4, "slots_active": 2,
+             "slot_occupancy": 1.0, "pool_utilization": 0.8,
+             "engine_steps": 10, "tokens_in_flight": 30,
+             "queue_age_p95_s": 0.2,
+             "admission_blocked_no_free_slot_total": 7,
+             "admission_blocked_pool_exhausted_total": 0,
+             "shed_queue_full_total": 3, "shed_queue_deadline_total": 1},
+            {"kind": "slo", "target": 0.99, "ttft_attainment": 0.97,
+             "e2e_attainment": 0.999, "ttft_objective_s": 0.5,
+             "e2e_objective_s": 5.0, "max_burn_rate": 3.0, "breach": True},
+        ])
+        report = build_report(str(tmp_path))
+        serving = report["serving"][0]
+        assert serving["shed_queue_full_total"] == 3
+        assert serving["shed_queue_deadline_total"] == 1
+        assert serving["slo_ttft_attainment"] == 0.97
+        assert serving["slo_breach"] is True
+        text = format_report(report)
+        assert "queue_full=3" in text
+        assert "queue_deadline=1" in text
+        assert "ttft=97.00%" in text
+        assert "BREACH" in text
+        assert "no_free_slot=7" in text
+
+    def test_training_only_dump_has_no_serving_section(self, tmp_path):
+        from accelerate_tpu.diagnostics import build_report, format_report
+
+        self._dump(tmp_path, [{"kind": "step", "step": 1}])
+        report = build_report(str(tmp_path))
+        assert report["serving"] == {}
+        assert "Serving" not in format_report(report)
+
+
+# --------------------------------------------------------------------- #
+# end-to-end overload smoke (make serve-obs-smoke)
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_overload_smoke_end_to_end(tiny_model, tmp_path):
+    """Engine under synthetic overload with the full plane attached:
+    every request completes or sheds (no unbounded queue), /metrics
+    serves live gauges MID-RUN, export_trace round-trips, and
+    `accelerate-tpu diagnose` names shed counts and SLO attainment."""
+    from accelerate_tpu.diagnostics import build_report, format_report
+    from accelerate_tpu.serving import SLOConfig, ServingEngine
+    from accelerate_tpu.telemetry import (
+        PrometheusTextSink,
+        StepTelemetry,
+        TelemetryConfig,
+    )
+
+    _, model, params = tiny_model
+    diag_dir = str(tmp_path / "diag")
+    tel = StepTelemetry(TelemetryConfig(diagnostics=diag_dir))
+    tel.add_sink(PrometheusTextSink(path=None))
+    engine = ServingEngine(
+        model, params, max_slots=2, block_size=8, telemetry=tel,
+        max_queue=4, max_queue_delay_s=0.05,
+        slo=SLOConfig(
+            ttft_objective_s=0.5, e2e_objective_s=5.0, target=0.9,
+            interval_steps=4, min_requests=2,
+        ),
+        gauge_interval=1,
+    )
+    exporter = engine.start_http()
+    rng = np.random.default_rng(0)
+    # overload: far more work than 2 slots and a 4-deep queue can hold
+    rids = [
+        engine.add_request(
+            rng.integers(1, 50, size=int(rng.integers(4, 12))),
+            max_new_tokens=int(rng.integers(4, 12)),
+        )
+        for _ in range(16)
+    ]
+    mid_run_metrics = None
+    while engine.has_work:
+        engine.step()
+        if mid_run_metrics is None:
+            mid_run_metrics = urllib.request.urlopen(
+                f"http://127.0.0.1:{exporter.port}/metrics", timeout=5
+            ).read().decode()
+
+    # zero requests in limbo: every id is terminal
+    finished = [r for r in rids if engine.result(r) is not None]
+    shed = [r for r in rids if engine.shed_reason(r) is not None]
+    assert len(finished) + len(shed) == len(rids)
+    assert shed, "overload trace must actually shed"
+    assert engine.trace_counts()["decode"] == 1  # zero retraces
+
+    # the mid-run scrape saw live gauges
+    assert "accelerate_tpu_serve_queue_depth" in mid_run_metrics
+    assert "accelerate_tpu_serve_slot_occupancy" in mid_run_metrics
+
+    state = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{exporter.port}/debug/state", timeout=5
+    ).read())
+    assert state["shed_total"] == len(shed)
+    engine.stop_http()
+
+    trace_path = str(tmp_path / "trace.json")
+    engine.export_trace(trace_path)
+    with open(trace_path) as f:
+        assert json.load(f)["traceEvents"]
+
+    tel.close()  # final flight dump
+    report = build_report(diag_dir)
+    text = format_report(report)
+    serving = report["serving"][0]
+    total_shed = (
+        (serving["shed_queue_full_total"] or 0)
+        + (serving["shed_queue_deadline_total"] or 0)
+    )
+    assert total_shed == len(shed)
+    assert serving["slo_ttft_attainment"] is not None
+    assert "Serving (latest posture per rank):" in text
+    assert "shed:" in text and "SLO" in text
