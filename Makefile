@@ -30,10 +30,11 @@ FAST_FILES = \
   tests/test_telemetry.py tests/test_compilation.py \
   tests/test_checkpoint_async.py tests/test_fused_accum.py \
   tests/test_diagnostics.py tests/test_benchmarks.py \
-  tests/test_serving.py tests/test_serving_obs.py
+  tests/test_serving.py tests/test_serving_obs.py \
+  tests/test_elastic.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
-  diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke
+  diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -106,6 +107,16 @@ serve-obs-smoke:
 	$(PYTEST) -q \
 	  tests/test_serving_obs.py::TestSchedulerShedding \
 	  tests/test_serving_obs.py::test_overload_smoke_end_to_end
+
+# elastic acceptance on CPU (<120s): a 4-process run loses rank 2 to an
+# injected SIGKILL at step 7, the supervisor declares the death, tears
+# down and relaunches 3 survivors, and the reshaped (4 -> 3) restore
+# resumes from the committed step-5 checkpoint — finishing with
+# bitwise-identical state and a loss curve identical to a clean 3-way
+# run resumed from the same checkpoint (slow-marked, so tier 1 skips it)
+elastic-smoke:
+	JAX_PLATFORMS=cpu $(PYTEST) -q \
+	  tests/test_elastic.py::test_elastic_kill_and_reform
 
 # diagnostics end-to-end on CPU: a tiny train loop with an injected slow
 # step and an injected NaN gradient runs with the flight recorder on,

@@ -1321,6 +1321,15 @@ class Accelerator:
             ckpt.wait()
 
     def load_state(self, input_dir: Optional[str] = None, carry: Any = None, **kwargs):
+        """Restore a checkpoint written by :meth:`save_state` (reference
+        :3023). ``allow_reshape=True`` permits topology-independent
+        restore: a checkpoint saved on N hosts loads onto the live M-host
+        fleet after full chunk-coverage validation, with explicit
+        re-derivation of the non-sliceable per-process state (RNG streams,
+        data-loader cursors, grad-accum remainder — see
+        :func:`~accelerate_tpu.checkpointing.load_accelerator_state`).
+        Without it, a topology mismatch fails with an error naming both
+        topologies."""
         self.wait_for_checkpoint()  # never restore past an in-flight save
         from .checkpointing import load_accelerator_state
 
@@ -1367,6 +1376,14 @@ class Accelerator:
         return objects
 
     clear = free_memory
+
+    def reform_mesh(self, devices=None):
+        """Re-form the device mesh from an explicit device set (elastic
+        survivor re-formation: the relaunched world sees fewer devices and
+        the plugin's auto axes re-absorb them). Shardings built against
+        the old mesh are stale after this — rebuild carries/templates
+        before stepping."""
+        return self.state.reform_mesh(devices)
 
     def skip_first_batches(self, dataloader, num_batches: int = 0):
         return skip_first_batches(dataloader, num_batches)

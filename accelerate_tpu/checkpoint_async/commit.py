@@ -21,9 +21,11 @@ directory or a complete one carrying ``COMMITTED``.
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import time
+from typing import Any, Optional
 
 from ..logging import get_logger
 
@@ -32,6 +34,7 @@ logger = get_logger(__name__)
 TMP_SUFFIX = ".tmp"
 COMMITTED_MARKER = "COMMITTED"
 DONE_MARKER_PATTERN = "done_{:05d}"
+TOPOLOGY_FILE = "topology.json"
 
 
 def work_dir_for(final_dir: str) -> str:
@@ -77,6 +80,39 @@ def write_marker(directory: str, name: str) -> str:
     return path
 
 
+def write_topology(work_dir: str, topology: dict[str, Any]) -> str:
+    """Durably write the save-time topology record into the work dir.
+
+    The record travels WITH the commit protocol (written before the
+    COMMITTED marker, visible only after the rename) so a committed
+    checkpoint always either carries a complete topology file or — for
+    checkpoints from before this field existed — none at all.
+    """
+    path = os.path.join(work_dir, TOPOLOGY_FILE)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(topology, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_path(work_dir)
+    return path
+
+
+def read_topology(checkpoint_dir: str) -> Optional[dict[str, Any]]:
+    """The topology record a committed checkpoint was saved under, or
+    ``None`` for pre-topology checkpoints (they load unchanged as long as
+    the live topology matches — ``allow_reshape`` cannot validate them)."""
+    path = os.path.join(checkpoint_dir, TOPOLOGY_FILE)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def mark_done(work_dir: str, process_index: int) -> str:
     """This host's shard files are written + fsynced; publish the fact."""
     return write_marker(work_dir, DONE_MARKER_PATTERN.format(process_index))
@@ -88,10 +124,20 @@ def wait_for_done_markers(
     timeout_s: float = 600.0,
     poll_s: float = 0.05,
 ) -> None:
-    """Block until every host's done marker exists (trivial when world==1)."""
+    """Block until every host's done marker exists (trivial when world==1).
+
+    The work dir VANISHING counts as the barrier passing: process 0
+    renames it to the final directory the instant it sees the last
+    marker, so another host whose scan loses that race (markers written,
+    rename already done) would otherwise poll a nonexistent directory
+    until the timeout — observed as a multi-host run wedging right after
+    a cadence save commits."""
     deadline = time.monotonic() + timeout_s
     missing = list(range(world))
     while missing:
+        if not os.path.isdir(work_dir):
+            # renamed away by process 0 => every marker existed
+            return
         missing = [
             p
             for p in missing
@@ -115,14 +161,20 @@ def commit(
     process_index: int = 0,
     world: int = 1,
     timeout_s: float = 600.0,
+    topology: Optional[dict[str, Any]] = None,
 ) -> str:
     """Run steps 2-4 of the protocol for this host; returns ``final_dir``.
 
     Process 0 performs the rename; other processes return once the final
-    directory is visible (so a caller may read it back immediately)."""
+    directory is visible (so a caller may read it back immediately).
+    ``topology`` (written by process 0, after the done-marker barrier so
+    it reflects a save every host finished) stamps the save-time world
+    size / mesh shape / shard-file map for topology-independent restore."""
     mark_done(work_dir, process_index)
     wait_for_done_markers(work_dir, world, timeout_s=timeout_s)
     if process_index == 0:
+        if topology is not None:
+            write_topology(work_dir, topology)
         write_marker(work_dir, COMMITTED_MARKER)
         if os.path.isdir(final_dir):
             # explicit-output_dir overwrite: swap the old dir aside first so

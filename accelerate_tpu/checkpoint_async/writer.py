@@ -55,6 +55,10 @@ class CheckpointJob:
     step: Optional[int]
     blocked_s: float  # snapshot + queue-wait seconds (filled by the caller)
     barrier_timeout_s: float = 600.0
+    # save-time topology record (checkpointing.topology_metadata), captured
+    # at submit time — the commit stamps it into the checkpoint so restore
+    # can validate/reshape even if the fleet changes while the write runs
+    topology: Optional[dict] = None
 
 
 class AsyncCheckpointer:
@@ -192,6 +196,7 @@ class AsyncCheckpointer:
             job.process_index,
             job.world,
             timeout_s=job.barrier_timeout_s,
+            topology=job.topology,
         )
         background_s = time.perf_counter() - t0
         self.saves_completed += 1
@@ -230,6 +235,7 @@ def save_accelerator_state_async(
         _is_arraylike,
         _to_host,
         flatten_tree,
+        topology_metadata,
     )
     from ..dist_checkpoint import snapshot_tree
 
@@ -273,6 +279,7 @@ def save_accelerator_state_async(
         step=accelerator.step,
         blocked_s=0.0,
         barrier_timeout_s=checkpointer.barrier_timeout_s,
+        topology=topology_metadata(accelerator),
     )
     job.blocked_s = time.perf_counter() - t0
     queue_wait = checkpointer.submit(job)

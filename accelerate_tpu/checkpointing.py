@@ -330,6 +330,32 @@ def _commit_mod():
     return commit
 
 
+def topology_metadata(accelerator) -> dict[str, Any]:
+    """The save-time topology record stamped into the commit protocol
+    (``topology.json``): everything a restore on a DIFFERENT fleet needs
+    to validate the checkpoint and to explain a mismatch — world size,
+    device count, mesh shape, and the process -> shard-file map."""
+    from .dist_checkpoint import INDEX_FILE_PATTERN, SHARD_FILE_PATTERN
+
+    world = accelerator.num_processes
+    num_devices = int(accelerator.state.num_devices)
+    return {
+        "format_version": 1,
+        "world_size": world,
+        "num_devices": num_devices,
+        "devices_per_process": num_devices // max(1, world),
+        "mesh_shape": {k: int(v) for k, v in accelerator.state.mesh.shape.items()},
+        "process_shard_files": {
+            str(p): {
+                "shard": SHARD_FILE_PATTERN.format(p),
+                "index": INDEX_FILE_PATTERN.format(p),
+            }
+            for p in range(world)
+        },
+        "step": accelerator.step,
+    }
+
+
 def _capture_host_state(accelerator, carry: Any = None) -> list[tuple[str, str, Any]]:
     """Snapshot the host-side small state as ``(filename, kind, payload)``
     triples (``kind`` in ``{"json", "pickle"}``), captured NOW so an async
@@ -468,7 +494,11 @@ def save_accelerator_state(
 
     accelerator.project_configuration.iteration += 1
     commit.commit(
-        work_dir, final_dir, accelerator.process_index, accelerator.num_processes
+        work_dir,
+        final_dir,
+        accelerator.process_index,
+        accelerator.num_processes,
+        topology=topology_metadata(accelerator),
     )
     accelerator.wait_for_everyone()
     telemetry = getattr(accelerator, "telemetry", None)
@@ -483,16 +513,57 @@ def save_accelerator_state(
         )
     return final_dir
 
+def _topology_mismatch(saved: dict, accelerator) -> Optional[str]:
+    """A one-line description of how the live fleet differs from the
+    save-time topology, or None when they match. Mesh-shape-only changes
+    on the same fleet (e.g. dp=2,fsdp=4 -> dp=4,fsdp=2) are NOT a
+    mismatch: the template's shardings already drive that re-slicing and
+    every per-host file is necessarily present."""
+    cur_world = accelerator.num_processes
+    cur_devices = int(accelerator.state.num_devices)
+    diffs = []
+    if int(saved.get("world_size", cur_world)) != cur_world:
+        diffs.append(f"world size {saved['world_size']} -> {cur_world}")
+    if int(saved.get("num_devices", cur_devices)) != cur_devices:
+        diffs.append(f"device count {saved['num_devices']} -> {cur_devices}")
+    return ", ".join(diffs) if diffs else None
+
+
 def load_accelerator_state(
     accelerator,
     input_dir: Optional[str] = None,
     carry: Any = None,
     params: Any = None,
+    allow_reshape: Optional[bool] = None,
 ) -> Any:
     """Restore state saved by :func:`save_accelerator_state` (reference
     checkpointing.py:152 / accelerator.py:3023). Pass the same-structured
     ``carry`` (or ``params``) as a template; returns it filled with
-    checkpointed values, re-placed on the template's shardings."""
+    checkpointed values, re-placed on the template's shardings.
+
+    ``allow_reshape`` controls topology-independent restore. A checkpoint
+    stamped with a different save-time topology (world size or device
+    count) refuses to load by default — the error names both topologies.
+    With ``allow_reshape=True`` the full chunk coverage across every
+    per-host file is validated first, the array state is re-sliced onto
+    the live shardings, and the non-sliceable host state follows explicit
+    re-derivation rules:
+
+    * **RNG**: every rank restores rank 0's saved streams, and the
+      KeyChain folds in the NEW process index — deterministic and
+      distinct per rank, but a different stream than an uninterrupted
+      run (unavoidable when ranks appear or disappear);
+    * **grad-accum remainder**: a carry saved mid-accumulation
+      (``micro_step != 0``) resumes at the last optimizer-step boundary
+      (the partial ``accum_grads`` sum is zeroed) because microbatch
+      boundaries do not map across world sizes;
+    * **data-loader cursor**: positions re-derive by samples seen, not
+      batch index (see ``DataLoaderShard.load_state_dict``).
+
+    ``allow_reshape=None`` (default) resolves from the
+    ``ACCELERATE_TPU_ELASTIC`` env flag, so runs relaunched by the
+    elastic supervisor reshape without every train script needing the
+    kwarg."""
     if input_dir is None:
         pc = accelerator.project_configuration
         base = os.path.join(pc.project_dir or ".", "checkpoints")
@@ -507,6 +578,43 @@ def load_accelerator_state(
     if os.path.isfile(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
+
+    if allow_reshape is None:
+        from .utils.constants import ENV_PREFIX
+        from .utils.environment import parse_flag_from_env
+
+        allow_reshape = parse_flag_from_env(ENV_PREFIX + "ELASTIC")
+    from .checkpoint_async.commit import read_topology
+
+    saved_topology = read_topology(input_dir)
+    mismatch = (
+        _topology_mismatch(saved_topology, accelerator)
+        if saved_topology is not None
+        else None
+    )
+    reshaped = mismatch is not None
+    if reshaped and not allow_reshape:
+        cur = topology_metadata(accelerator)
+        raise ValueError(
+            f"checkpoint {input_dir} was saved on a different topology "
+            f"({mismatch}): saved world_size={saved_topology['world_size']} "
+            f"num_devices={saved_topology.get('num_devices')} "
+            f"mesh={saved_topology.get('mesh_shape')}, live "
+            f"world_size={cur['world_size']} num_devices={cur['num_devices']} "
+            f"mesh={cur['mesh_shape']}. Pass allow_reshape=True to "
+            "load_state (or launch under --elastic) to re-slice the shards "
+            "onto the live topology."
+        )
+    if reshaped:
+        from .dist_checkpoint import is_sharded_checkpoint, validate_coverage
+
+        if is_sharded_checkpoint(input_dir):
+            stats = validate_coverage(input_dir)
+            logger.warning(
+                f"reshaping checkpoint {input_dir} ({mismatch}): "
+                f"{stats['chunks']} chunks across {stats['files']} per-host "
+                f"files fully cover all {stats['leaves']} leaves"
+            )
 
     template = carry if carry is not None else params
     result = None
@@ -551,7 +659,12 @@ def load_accelerator_state(
     rng_path = os.path.join(
         input_dir, f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl"
     )
-    if not os.path.isfile(rng_path):
+    if reshaped or not os.path.isfile(rng_path):
+        # re-derivation rule: on a topology change a rank's own saved RNG
+        # file may not exist (M>N) or may belong to a rank holding
+        # different data shards (M<N), so EVERY rank restores rank 0's
+        # streams and the keychain folds in the new process index below —
+        # deterministic per (checkpoint, new rank), never rank-aliased.
         rng_path = os.path.join(input_dir, f"{RNG_STATE_NAME}_0.pkl")
     if os.path.isfile(rng_path):
         import random as _py_random
@@ -561,6 +674,36 @@ def load_accelerator_state(
         _py_random.setstate(rng["python"])
         np.random.set_state(rng["numpy"])
         accelerator.keys.load_state_dict(rng["keychain"])
+        if reshaped:
+            from .utils.random import KeyChain
+
+            accelerator.keys = KeyChain(
+                accelerator.keys.fold_in(accelerator.process_index)
+            )
+
+    if reshaped and isinstance(result, dict) and "micro_step" in result:
+        micro = int(np.asarray(jax.device_get(result["micro_step"])))
+        if micro != 0:
+            logger.warning(
+                f"checkpoint was saved mid-accumulation (micro_step={micro}); "
+                "microbatch boundaries do not map across world sizes, so the "
+                "partial gradient sum is dropped and the run resumes at the "
+                "last optimizer-step boundary"
+            )
+            def _zeros_like_sharded(x):
+                z = jnp.zeros(x.shape, x.dtype)
+                if isinstance(
+                    getattr(x, "sharding", None), jax.sharding.NamedSharding
+                ):
+                    z = jax.device_put(z, x.sharding)
+                return z
+
+            result = dict(result)
+            result["micro_step"] = _zeros_like_sharded(result["micro_step"])
+            if "accum_grads" in result:
+                result["accum_grads"] = jax.tree.map(
+                    _zeros_like_sharded, result["accum_grads"]
+                )
 
     if "step" in meta:
         accelerator.step = int(meta["step"])

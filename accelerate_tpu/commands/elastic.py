@@ -1,0 +1,394 @@
+"""Elastic supervisor: re-form the world with the survivors.
+
+The supervised-restart story (``--max_restarts``) relaunches the whole
+fleet at the ORIGINAL world size — fine for transient crashes, useless
+when a host is actually gone (preempted, hardware-failed): the relaunch
+blocks at coordinator init waiting for a rank that will never come back.
+This module is the actuation half of the PR-5 detection stack
+(heartbeats + flight recorder + ``diagnose``):
+
+1. run one *generation*: spawn ``world`` training processes wired
+   through a fresh localhost ``jax.distributed`` coordinator;
+2. declare a rank dead when its process exits nonzero, or when its
+   heartbeat file (``telemetry/heartbeat.py``) goes stale mid-run;
+3. tear down the remainder cleanly — SIGTERM so each survivor's
+   :class:`~accelerate_tpu.fault_tolerance.CheckpointManager` attempts
+   its final checkpoint where reachable (a survivor wedged in a
+   collective against the dead rank cannot finish a *collective* save;
+   the atomic commit protocol guarantees an unfinished attempt stays
+   invisible, so restore falls back to the last committed cadence
+   checkpoint), then SIGKILL whatever is still alive after the grace
+   period;
+4. recompute the healthy world (``world - dead``), renumber ranks
+   ``0..M-1``, and relaunch the next generation at the reduced size.
+   Relaunched processes see ``ACCELERATE_TPU_ELASTIC=1`` so
+   ``restore_or_init``/``load_state`` default to ``allow_reshape``:
+   the N-host checkpoint re-slices onto the M-host mesh
+   (:mod:`~accelerate_tpu.dist_checkpoint` coverage-validated restore).
+
+A generation whose every process exits 0 ends the run successfully;
+fewer than ``min_processes`` survivors, or ``max_generations``
+exhausted, ends it with a failure.
+
+Scope: this supervisor drives LOCAL processes (one per rank, CPU
+backend by default) — the ``--elastic`` mode of ``accelerate-tpu
+launch`` pairs it with ``--debug_num_processes``, and it is the engine
+of the elastic tests. On a real pod the same loop runs on the
+controller with the spawn step replaced by the gcloud fan-out; the
+generation/teardown/reshape contract is identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Callable, Optional, Sequence
+
+from ..logging import get_logger
+from ..utils.constants import ENV_PREFIX
+
+logger = get_logger(__name__)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class GenerationRecord:
+    """What one generation did — the supervisor's auditable history."""
+
+    generation: int
+    world: int
+    outcome: str  # "success" | "rank_death" | "below_min"
+    dead_ranks: list[int]
+    exit_codes: dict[int, Optional[int]]
+    duration_s: float
+
+    def to_json(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class ElasticSupervisor:
+    """Generation loop around a training command (see module docstring).
+
+    ``cmd``: the training command, relaunched verbatim each generation.
+    ``heartbeat_dir``: where ranks write ``heartbeat-rank{i}.json``; also
+    receives the supervisor's ``elastic-events.jsonl``. Heartbeat-based
+    death declaration needs it; exit-based declaration works without.
+    ``stall_timeout_s``: silence after a rank's FIRST beat that declares
+    it dead (never-beaten ranks are only caught by process exit — a rank
+    may legitimately spend a long time importing/compiling before its
+    first step). ``grace_period_s``: SIGTERM -> SIGKILL window at
+    teardown. ``generation_hook(generation, world)`` runs before each
+    spawn (tests use it to snapshot checkpoints between generations).
+    ``cpu=True`` pins children to the CPU backend (the local debug
+    topology); pass False when the child env already selects a platform.
+    """
+
+    def __init__(
+        self,
+        cmd: Sequence[str],
+        num_processes: int,
+        min_processes: int = 1,
+        heartbeat_dir: Optional[str] = None,
+        stall_timeout_s: float = 60.0,
+        grace_period_s: float = 10.0,
+        max_generations: int = 8,
+        monitor_interval_s: float = 0.2,
+        generation_timeout_s: Optional[float] = None,
+        env: Optional[dict[str, str]] = None,
+        cpu: bool = True,
+        generation_hook: Optional[Callable[[int, int], None]] = None,
+    ):
+        if num_processes < 1:
+            raise ValueError("num_processes must be >= 1")
+        if not (1 <= min_processes <= num_processes):
+            raise ValueError(
+                f"min_processes must be in [1, num_processes]; got "
+                f"{min_processes} with num_processes={num_processes}"
+            )
+        self.cmd = list(cmd)
+        self.num_processes = num_processes
+        self.min_processes = min_processes
+        self.heartbeat_dir = heartbeat_dir
+        self.stall_timeout_s = stall_timeout_s
+        self.grace_period_s = grace_period_s
+        self.max_generations = max_generations
+        self.monitor_interval_s = monitor_interval_s
+        self.generation_timeout_s = generation_timeout_s
+        self.env = dict(env or {})
+        self.cpu = cpu
+        self.generation_hook = generation_hook
+        self.history: list[GenerationRecord] = []
+        if heartbeat_dir:
+            os.makedirs(heartbeat_dir, exist_ok=True)
+
+    # ------------------------------------------------------------------ #
+    def _child_env(self, rank: int, world: int, generation: int, port: int):
+        env = {**os.environ, **self.env}
+        if self.cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+        env[ENV_PREFIX + "NUM_PROCESSES"] = str(world)
+        env[ENV_PREFIX + "PROCESS_ID"] = str(rank)
+        env[ENV_PREFIX + "COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env[ENV_PREFIX + "ELASTIC"] = "1"
+        env[ENV_PREFIX + "ELASTIC_GENERATION"] = str(generation)
+        env[ENV_PREFIX + "ELASTIC_MIN_PROCESSES"] = str(self.min_processes)
+        env[ENV_PREFIX + "RESTART_COUNT"] = str(generation)
+        # a heartbeat-declared death gets SIGABRT before SIGKILL so the
+        # wedged rank's stack lands in its log — worthless without this
+        env.setdefault("PYTHONFAULTHANDLER", "1")
+        if self.heartbeat_dir:
+            env[ENV_PREFIX + "ELASTIC_HEARTBEAT_DIR"] = self.heartbeat_dir
+        return env
+
+    def _child_stdio(self, rank: int, generation: int):
+        """Per-rank log file under the heartbeat dir (post-mortems need
+        each rank's own output, not an interleaved console)."""
+        if not self.heartbeat_dir:
+            return None
+        path = os.path.join(
+            self.heartbeat_dir, f"rank{rank}-gen{generation}.log"
+        )
+        return open(path, "ab")
+
+    def _event(self, kind: str, **fields) -> None:
+        record = {"event": kind, "time_unix": time.time(), **fields}
+        logger.info(f"elastic: {kind} {fields}")
+        if not self.heartbeat_dir:
+            return
+        try:
+            path = os.path.join(self.heartbeat_dir, "elastic-events.jsonl")
+            with open(path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except OSError:
+            pass  # event log is observability, never a failure source
+
+    # ------------------------------------------------------------------ #
+    def run(self) -> int:
+        """Run generations until success, exhaustion, or too few
+        survivors. Returns a process exit code (0 = trained to
+        completion)."""
+        world = self.num_processes
+        for generation in range(self.max_generations):
+            if self.generation_hook is not None:
+                self.generation_hook(generation, world)
+            record = self._run_generation(generation, world)
+            self.history.append(record)
+            if record.outcome == "success":
+                self._event("run_complete", generations=generation + 1)
+                return 0
+            survivors = world - len(record.dead_ranks)
+            if survivors < self.min_processes:
+                record.outcome = "below_min"
+                self._event(
+                    "giving_up",
+                    survivors=survivors,
+                    min_processes=self.min_processes,
+                    dead_ranks=record.dead_ranks,
+                )
+                logger.error(
+                    f"elastic: {survivors} survivor(s) after generation "
+                    f"{generation} is below --min_processes="
+                    f"{self.min_processes}; giving up"
+                )
+                return 1
+            self._event(
+                "reforming",
+                generation=generation + 1,
+                old_world=world,
+                new_world=survivors,
+                dead_ranks=record.dead_ranks,
+            )
+            world = survivors
+        logger.error(
+            f"elastic: exhausted max_generations={self.max_generations} "
+            "without a clean finish"
+        )
+        return 1
+
+    # ------------------------------------------------------------------ #
+    def _run_generation(self, generation: int, world: int) -> GenerationRecord:
+        t0 = time.monotonic()
+        port = _free_port()
+        self._event("generation_start", generation=generation, world=world, port=port)
+        procs: dict[int, subprocess.Popen] = {}
+        logs = []
+        for rank in range(world):
+            log = self._child_stdio(rank, generation)
+            if log is not None:
+                logs.append(log)
+            procs[rank] = subprocess.Popen(
+                self.cmd,
+                env=self._child_env(rank, world, generation, port),
+                stdout=log,
+                stderr=subprocess.STDOUT if log is not None else None,
+            )
+        for log in logs:  # children hold their own copies now
+            log.close()
+        deadline = (
+            time.monotonic() + self.generation_timeout_s
+            if self.generation_timeout_s
+            else None
+        )
+        dead: set[int] = set()
+        while True:
+            running = {r: p for r, p in procs.items() if p.poll() is None}
+            dead = {
+                r
+                for r, p in procs.items()
+                if p.poll() is not None and p.returncode != 0
+            }
+            if not dead and self.heartbeat_dir and self.stall_timeout_s:
+                from ..telemetry.heartbeat import scan_heartbeats
+
+                records = scan_heartbeats(
+                    self.heartbeat_dir, stall_timeout_s=self.stall_timeout_s
+                )
+                stale = {
+                    r: rec
+                    for r, rec in records.items()
+                    if rec.get("generation") == generation
+                    and rec["stale"]
+                    and r in running
+                }
+                if stale:
+                    # when one rank wedges, EVERY rank goes silent within a
+                    # step (they all block at the next collective) — so
+                    # declare dead only the rank that went silent FIRST
+                    # (oldest last beat: the straggler); the rest are
+                    # survivors and re-form. A hung rank gets SIGKILL, not
+                    # SIGTERM: it is wedged, the final-checkpoint contract
+                    # cannot run anyway.
+                    victim = min(
+                        stale, key=lambda r: stale[r].get("time_unix", 0.0)
+                    )
+                    self._event(
+                        "heartbeat_death",
+                        generation=generation,
+                        rank=victim,
+                        last_step=stale[victim].get("step"),
+                        age_s=stale[victim].get("age_s"),
+                    )
+                    # SIGABRT first: with PYTHONFAULTHANDLER the victim's
+                    # wedged stack prints to its log before it dies
+                    self._kill(running[victim], signal.SIGABRT)
+                    try:
+                        running[victim].wait(timeout=3)
+                    except subprocess.TimeoutExpired:
+                        self._kill(running[victim], signal.SIGKILL)
+                        running[victim].wait()
+                    dead.add(victim)
+            if dead:
+                self._event(
+                    "rank_death",
+                    generation=generation,
+                    dead_ranks=sorted(dead),
+                    exit_codes={
+                        r: procs[r].returncode for r in sorted(dead)
+                    },
+                )
+                self._teardown(
+                    {r: p for r, p in procs.items() if p.poll() is None}
+                )
+                break
+            if not running:
+                return GenerationRecord(
+                    generation=generation,
+                    world=world,
+                    outcome="success",
+                    dead_ranks=[],
+                    exit_codes={r: p.returncode for r, p in procs.items()},
+                    duration_s=time.monotonic() - t0,
+                )
+            if deadline is not None and time.monotonic() > deadline:
+                self._event(
+                    "generation_timeout",
+                    generation=generation,
+                    running=sorted(running),
+                )
+                # nobody exited, nobody was declared dead: treat every
+                # still-running rank as hung
+                for p in running.values():
+                    self._kill(p, signal.SIGKILL)
+                for p in running.values():
+                    p.wait()
+                dead = set(running)
+                break
+            time.sleep(self.monitor_interval_s)
+        return GenerationRecord(
+            generation=generation,
+            world=world,
+            outcome="rank_death",
+            dead_ranks=sorted(dead),
+            exit_codes={r: p.returncode for r, p in procs.items()},
+            duration_s=time.monotonic() - t0,
+        )
+
+    # ------------------------------------------------------------------ #
+    def _kill(self, proc: subprocess.Popen, sig: int) -> None:
+        try:
+            proc.send_signal(sig)
+        except (ProcessLookupError, OSError):
+            pass
+
+    def _teardown(self, survivors: dict[int, subprocess.Popen]) -> None:
+        """SIGTERM -> grace -> SIGKILL. The SIGTERM gives each survivor's
+        CheckpointManager its final-checkpoint attempt; a survivor stuck
+        in a collective against the dead rank never reaches the handler's
+        next step() check, which is exactly what the grace SIGKILL is
+        for. Any unfinished save stays an invisible ``.tmp`` work dir."""
+        if not survivors:
+            return
+        for p in survivors.values():
+            self._kill(p, signal.SIGTERM)
+        deadline = time.monotonic() + self.grace_period_s
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in survivors.values()):
+                break
+            time.sleep(0.05)
+        killed = []
+        for rank, p in survivors.items():
+            if p.poll() is None:
+                killed.append(rank)
+                self._kill(p, signal.SIGKILL)
+        for p in survivors.values():
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+        if killed:
+            self._event("teardown_sigkill", ranks=sorted(killed))
+
+
+def elastic_launcher_command(args, cfg) -> int:
+    """``accelerate-tpu launch --elastic`` entry: wrap the training script
+    in an :class:`ElasticSupervisor` over local processes."""
+    n = args.debug_num_processes
+    if not n:
+        raise SystemExit(
+            "--elastic drives local processes: pass --debug_num_processes N "
+            "(on a pod, run this supervisor on the controller so the "
+            "gcloud fan-out IS the spawn step)"
+        )
+    supervisor = ElasticSupervisor(
+        cmd=[sys.executable, args.training_script, *args.training_script_args],
+        num_processes=n,
+        min_processes=args.min_processes,
+        heartbeat_dir=args.heartbeat_dir,
+        stall_timeout_s=args.stall_timeout,
+        grace_period_s=args.grace_period,
+        max_generations=args.max_restarts + 1 if args.max_restarts else 8,
+        env=cfg.to_env(),
+    )
+    return supervisor.run()

@@ -74,6 +74,28 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--monitor_interval", type=_non_negative_f, default=5.0,
                         help="Seconds to wait before each relaunch "
                         "(reference torchelastic monitor_interval)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="Elastic supervision: on a rank death, tear "
+                        "down the survivors (SIGTERM -> final checkpoint "
+                        "where reachable), re-form the world at the reduced "
+                        "size and resume from the last committed checkpoint "
+                        "(survivors relaunch with ACCELERATE_TPU_ELASTIC=1, "
+                        "so load_state reshapes the N-host checkpoint onto "
+                        "the M-host mesh). Pair with --debug_num_processes.")
+    parser.add_argument("--min_processes", type=int, default=1,
+                        help="Elastic floor: give up instead of re-forming "
+                        "below this many survivors")
+    parser.add_argument("--stall_timeout", type=float, default=60.0,
+                        help="Elastic: seconds of heartbeat silence (after "
+                        "a rank's first beat) that declare it dead")
+    parser.add_argument("--grace_period", type=float, default=10.0,
+                        help="Elastic: SIGTERM -> SIGKILL window at "
+                        "survivor teardown")
+    parser.add_argument("--heartbeat_dir", default=None,
+                        help="Elastic: directory of heartbeat-rank*.json "
+                        "files (enables heartbeat-based death detection; "
+                        "exported to ranks as "
+                        "ACCELERATE_TPU_ELASTIC_HEARTBEAT_DIR)")
     parser.add_argument("--gcloud", action="store_true",
                         help="Fan out to all pod workers via gcloud ssh")
     parser.add_argument("--tpu_name", default=None)
@@ -251,7 +273,11 @@ def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
 
 def launch_command(args) -> None:
     cfg = _merge_config(args)
-    if args.debug_num_processes:
+    if getattr(args, "elastic", False):
+        from .elastic import elastic_launcher_command
+
+        rc = elastic_launcher_command(args, cfg)
+    elif args.debug_num_processes:
         rc = debug_launcher_command(args, cfg)
     elif args.gcloud:
         rc = tpu_pod_launcher(args, cfg)

@@ -343,6 +343,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.sampler = sampler
         self.epoch = 0
         self._skip_batches = _skip_batches
+        self._batches_yielded = 0  # position within the current epoch
         self.end_of_dataloader = False
         self.remainder = -1
         # set by Accelerator.prepare_data_loader: a StepTelemetry that gets
@@ -436,6 +437,41 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.epoch = epoch
         if self.sampler is not None and hasattr(self.sampler, "set_epoch"):
             self.sampler.set_epoch(epoch)
+
+    def state_dict(self) -> dict:
+        """Checkpointable cursor: epoch + intra-epoch position, plus the
+        global batch size the position was counted under so a restore on a
+        different topology can re-derive it by samples seen."""
+        return {
+            "epoch": self.epoch,
+            "batches_yielded": self._batches_yielded,
+            "global_batch_size": self.global_batch_size * self.superbatch,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore the cursor. Same global batch size: skip exactly the
+        yielded batches. Different (a reshaped restore whose per-process
+        count changed the effective global batch): re-derive the position
+        from SAMPLES seen — ``batches * saved_gbs // live_gbs`` — rounded
+        DOWN to a whole live batch, so no sample is skipped unseen (a few
+        may repeat; the conservative side of the trade)."""
+        self.set_epoch(int(state.get("epoch", 0)))
+        seen = int(state.get("batches_yielded", 0))
+        saved_gbs = int(state.get("global_batch_size", 0) or 0)
+        live_gbs = self.global_batch_size * self.superbatch
+        if saved_gbs and live_gbs and saved_gbs != live_gbs:
+            samples = seen * saved_gbs
+            seen = samples // live_gbs
+            logger.warning(
+                "dataloader cursor re-derived for a changed global batch "
+                "size (%d -> %d): %d samples seen -> resume at batch %d",
+                saved_gbs,
+                live_gbs,
+                samples,
+                seen,
+            )
+        self._skip_batches = seen
+        self._batches_yielded = seen
 
     def _device_put(self, host_batch: Any, valid: int) -> Any:
         """Host numpy pytree -> global sharded jax.Array pytree.
@@ -540,6 +576,8 @@ class DataLoaderShard(DataLoaderStateMixin):
             thread = threading.Thread(target=_producer, daemon=True)
             thread.start()
 
+            # skipped batches count as consumed positions in the cursor
+            self._batches_yielded = self._skip_batches
             current = self._timed_get(q)
             if isinstance(current, BaseException):
                 raise current
@@ -561,6 +599,7 @@ class DataLoaderShard(DataLoaderStateMixin):
                     self.end_of_dataloader = True
                     self.remainder = valid if valid != gbs else 0
                 yield batch
+                self._batches_yielded += 1
                 current = nxt
         finally:
             cancelled.set()
@@ -572,6 +611,8 @@ class DataLoaderShard(DataLoaderStateMixin):
                 pass
             self.end()
             self._skip_batches = 0
+            if self.end_of_dataloader:
+                self._batches_yielded = 0  # full epoch consumed
 
 
 class DataLoaderDispatcher(DataLoaderShard):
@@ -644,6 +685,7 @@ class DataLoaderDispatcher(DataLoaderShard):
                 return self._device_put(local_batch, valid), valid
 
             # one-payload lookahead so the last batch is marked before yield
+            self._batches_yielded = self._skip_batches
             current = _next_payload_timed()
             while not current[2]:
                 nxt = _next_payload_timed()
@@ -653,10 +695,13 @@ class DataLoaderDispatcher(DataLoaderShard):
                     full = self.global_batch_size * self.superbatch
                     self.remainder = valid if valid != full else 0
                 yield batch
+                self._batches_yielded += 1
                 current = nxt
         finally:
             self.end()
             self._skip_batches = 0
+            if self.end_of_dataloader:
+                self._batches_yielded = 0  # full epoch consumed
 
 
 def prepare_data_loader(

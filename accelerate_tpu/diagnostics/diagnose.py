@@ -97,6 +97,48 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
         last_checkpoint = dict(last_checkpoint)
         last_checkpoint["committed"] = _checkpoint_status(last_checkpoint.get("dir"))
 
+    # --- can the survivors restart (elastic verdict) ------------------- #
+    # who is still beating vs the newest committed checkpoint's saved
+    # topology: names how many ranks an elastic relaunch would have, and
+    # whether that relaunch is a reshaped (N -> M) restore.
+    elastic = None
+    if heartbeats:
+        survivors = sorted(
+            r
+            for r, info in ranks.items()
+            if info.get("heartbeat_age_s") is not None and not info.get("stale")
+        )
+        elastic = {
+            "survivors": survivors,
+            "num_survivors": len(survivors),
+            "num_ranks": len(ranks),
+            "saved_topology": None,
+            "needs_reshape": None,
+            "restartable": None,
+        }
+        ck = last_checkpoint or {}
+        if ck.get("dir") and ck.get("committed"):
+            try:
+                from ..checkpoint_async.commit import read_topology
+
+                topo = read_topology(ck["dir"])
+            except Exception:
+                topo = None
+            if topo is not None:
+                elastic["saved_topology"] = {
+                    "world_size": topo.get("world_size"),
+                    "num_devices": topo.get("num_devices"),
+                    "step": topo.get("step"),
+                }
+                elastic["needs_reshape"] = (
+                    topo.get("world_size") != len(survivors)
+                )
+            elastic["restartable"] = len(survivors) >= 1
+        elif ck.get("committed") is False:
+            elastic["restartable"] = False
+        # committed None (dir unreachable / no checkpoint recorded):
+        # restartable stays None — "cannot verify from here"
+
     # --- where did the time go ----------------------------------------- #
     goodput_pcts = []
     badput: dict[str, float] = {b: 0.0 for b in BUCKETS}
@@ -171,6 +213,7 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
         "ranks": {r: ranks[r] for r in sorted(ranks)},
         "straggler": straggler,
         "last_checkpoint": last_checkpoint,
+        "elastic": elastic,
         "goodput_pct": (
             sum(goodput_pcts) / len(goodput_pcts) if goodput_pcts else None
         ),
@@ -217,6 +260,32 @@ def format_report(report: dict) -> str:
         )
     else:
         lines.append("Last checkpoint: none recorded")
+
+    elastic = report.get("elastic")
+    if elastic is not None:
+        m, n = elastic["num_survivors"], elastic["num_ranks"]
+        if elastic["restartable"]:
+            line = f"Elastic: RESTARTABLE with {m} survivor(s) of {n}"
+            topo = elastic.get("saved_topology")
+            if topo is not None:
+                line += f" from step {topo.get('step')}"
+                if elastic.get("needs_reshape"):
+                    line += (
+                        f" (reshaped restore: checkpoint topology is "
+                        f"world_size={topo.get('world_size')} — relaunch "
+                        f"under --elastic or load_state(allow_reshape=True))"
+                    )
+            lines.append(line)
+        elif elastic["restartable"] is False:
+            lines.append(
+                f"Elastic: NOT restartable — {m} survivor(s) of {n} but no "
+                "committed checkpoint to resume from"
+            )
+        else:
+            lines.append(
+                f"Elastic: {m} survivor(s) of {n}; checkpoint not verifiable "
+                "from here"
+            )
 
     gp = report.get("goodput_pct")
     lines.append("")

@@ -236,6 +236,97 @@ def _merged_manifest(input_dir: str) -> dict[str, dict]:
     return merged
 
 
+def validate_coverage(
+    input_dir: str, manifest: Optional[dict[str, dict]] = None
+) -> dict[str, int]:
+    """Prove the merged manifests tile every leaf's global shape exactly
+    once and every referenced shard file exists.
+
+    ``_read_region`` detects gaps only inside the regions a restore
+    actually asks for — and its element *count* cannot tell an overlap
+    from missing data. A topology-changing restore reads DIFFERENT
+    regions than the save wrote, so before reshaping we check the whole
+    checkpoint: per leaf, project every chunk's bounds onto each dim to
+    get a coordinate grid, then require each grid cell to be covered by
+    exactly one chunk. Cost is O(cells x chunks) on manifest metadata
+    only (no tensor IO), where cells ~ the save-time shard count.
+
+    Raises ``ValueError`` naming the leaf and the uncovered/overlapping
+    region, or ``FileNotFoundError`` naming the missing shard files.
+    Returns ``{"leaves": ..., "chunks": ..., "files": ...}`` on success.
+    """
+    import itertools
+
+    manifest = _merged_manifest(input_dir) if manifest is None else manifest
+    files: set[str] = set()
+    n_chunks = 0
+    missing_files: set[str] = set()
+    for key, entry in manifest.items():
+        shape = tuple(entry["shape"])
+        chunks = entry["chunks"]
+        n_chunks += len(chunks)
+        for chunk in chunks:
+            fname = chunk["file"]
+            if fname not in files:
+                files.add(fname)
+                if not os.path.isfile(os.path.join(input_dir, fname)):
+                    missing_files.add(fname)
+        if not shape:
+            # 0-dim leaf: any one chunk covers it
+            if not chunks:
+                raise ValueError(
+                    f"checkpoint leaf {key!r} has no chunks — incomplete "
+                    f"manifest under {input_dir}"
+                )
+            continue
+        # per-dim sorted boundary coordinates from all chunk extents
+        cuts = [sorted({0, d}) for d in shape]
+        for chunk in chunks:
+            for i, (off, size) in enumerate(zip(chunk["offset"], chunk["shape"])):
+                for c in (off, off + size):
+                    if 0 <= c <= shape[i] and c not in cuts[i]:
+                        cuts[i].append(c)
+        cuts = [sorted(c) for c in cuts]
+        cells = itertools.product(
+            *(zip(c[:-1], c[1:]) for c in cuts)
+        )
+        for cell in cells:
+            covering = 0
+            for chunk in chunks:
+                if all(
+                    off <= lo and hi <= off + size
+                    for (lo, hi), off, size in zip(
+                        cell, chunk["offset"], chunk["shape"]
+                    )
+                ):
+                    covering += 1
+            if covering != 1:
+                region = tuple(f"{lo}:{hi}" for lo, hi in cell)
+                problem = (
+                    "is not covered by any chunk"
+                    if covering == 0
+                    else f"is covered by {covering} overlapping chunks"
+                )
+                raise ValueError(
+                    f"checkpoint leaf {key!r} (shape {shape}): region "
+                    f"[{', '.join(region)}] {problem} — the per-host files "
+                    f"under {input_dir} do not assemble into a complete "
+                    "checkpoint"
+                )
+    if missing_files:
+        raise FileNotFoundError(
+            f"checkpoint under {input_dir} references shard files that do "
+            f"not exist: {sorted(missing_files)} — a per-host file was "
+            "deleted or never copied; restore onto a different topology "
+            "needs every save-time host's file"
+        )
+    return {
+        "leaves": len(manifest),
+        "chunks": n_chunks,
+        "files": len(files),
+    }
+
+
 class _FileCache:
     """Open each safetensors shard file once per restore, not once per
     chunk — the restore path touches O(leaves x device-shards) chunks and

@@ -28,10 +28,11 @@ Usage::
 
 from __future__ import annotations
 
+import atexit
 import os
 import signal
 import threading
-from typing import Any, Optional, Tuple
+from typing import Any, Iterable, Optional, Tuple
 
 from .logging import get_logger
 
@@ -70,6 +71,7 @@ class CheckpointManager:
         heartbeat=None,
         async_saves: bool = False,
         max_pending: int = 1,
+        signals: Iterable[int] = (signal.SIGTERM,),
     ):
         if every_n_steps < 1:
             raise ValueError("every_n_steps must be >= 1")
@@ -104,12 +106,22 @@ class CheckpointManager:
         self._preempted = threading.Event()
         self._preemption_logged = False
         self._stopped = False
+        self._closed = False
         self._prev_handlers: dict[int, Any] = {}
+        # ``signals``: which signals request the final-checkpoint-then-stop
+        # contract. SIGTERM is the Cloud TPU preemption notice; add
+        # signal.SIGINT to give Ctrl-C the same durable-stop semantics
+        # (signals=(signal.SIGTERM, signal.SIGINT)) — without it SIGINT
+        # keeps raising KeyboardInterrupt as usual.
         if handle_signals and threading.current_thread() is threading.main_thread():
-            for sig in (signal.SIGTERM,):
+            for sig in signals:
                 self._prev_handlers[sig] = signal.signal(
                     sig, self._on_preemption
                 )
+        # an abandoned manager (no close()/__exit__) still drains its
+        # background writer at interpreter exit; close() is idempotent, so
+        # the usual close -> atexit double call is safe
+        atexit.register(self.close)
 
     # ------------------------------------------------------------------ #
     def _on_preemption(self, signum, frame):
@@ -127,21 +139,46 @@ class CheckpointManager:
         return self._stopped
 
     # ------------------------------------------------------------------ #
-    def restore_or_init(self, carry: Any) -> Tuple[Any, bool]:
+    def restore_or_init(
+        self, carry: Any, allow_reshape: Optional[bool] = None
+    ) -> Tuple[Any, bool]:
         """Resume from the newest complete checkpoint if one exists, else
-        return ``carry`` unchanged. Call once before the train loop."""
+        return ``carry`` unchanged. Call once before the train loop.
+
+        Error-path hardening: a checkpoint that was committed but later
+        corrupted (a shard file deleted from shared storage, a torn
+        manifest) must not kill the restart loop — restore falls back to
+        the next-newest committed checkpoint with a warning. Only when
+        EVERY checkpoint fails does the last error propagate.
+
+        ``allow_reshape`` forwards to :meth:`Accelerator.load_state`
+        (``None``: resolves from the ``ACCELERATE_TPU_ELASTIC`` env flag
+        the elastic supervisor sets on relaunched survivors)."""
         pc = self.accelerator.project_configuration
         base = os.path.join(pc.project_dir or ".", "checkpoints")
         from .checkpointing import _list_checkpoints
 
         if not os.path.isdir(base) or not _list_checkpoints(base):
             return carry, False
-        restored = self.accelerator.load_state(carry=carry)
-        logger.info(
-            f"resumed from step {self.accelerator.step} "
-            f"({_list_checkpoints(base)[-1]})"
-        )
-        return restored, True
+        last_exc: Optional[Exception] = None
+        for ck in reversed(_list_checkpoints(base)):
+            try:
+                restored = self.accelerator.load_state(
+                    ck, carry=carry, allow_reshape=allow_reshape
+                )
+            except Exception as exc:
+                logger.warning(
+                    f"checkpoint {ck} is unusable ({exc!r}); "
+                    "falling back to the next-newest committed checkpoint"
+                )
+                last_exc = exc
+                continue
+            logger.info(f"resumed from step {self.accelerator.step} ({ck})")
+            return restored, True
+        raise RuntimeError(
+            f"every checkpoint under {base} failed to load; the newest "
+            "failure is chained below"
+        ) from last_exc
 
     def step(self, carry: Any) -> Optional[str]:
         """Call once per optimizer step. Saves on the cadence (async when
@@ -196,11 +233,25 @@ class CheckpointManager:
 
     def close(self):
         """Drain background saves and restore previous signal handlers
-        (tests / nested use)."""
+        (tests / nested use). Idempotent: ``__exit__`` and the atexit
+        hook both call it, and a second call must neither re-restore
+        handlers (clobbering whatever was installed since) nor touch the
+        already-stopped writer."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            atexit.unregister(self.close)
+        except Exception:
+            pass
         if self._checkpointer is not None:
             self._checkpointer.close()
-        for sig, handler in self._prev_handlers.items():
-            signal.signal(sig, handler)
+        if threading.current_thread() is threading.main_thread():
+            for sig, handler in self._prev_handlers.items():
+                # only un-install our own handler: if someone re-bound the
+                # signal after us (a newer manager), leave theirs in place
+                if signal.getsignal(sig) == self._on_preemption:
+                    signal.signal(sig, handler)
         self._prev_handlers.clear()
 
     def __enter__(self):

@@ -72,6 +72,17 @@ def _maybe_init_distributed(kwargs: Optional[DistributedInitKwargs]) -> None:
         extra["initialization_timeout"] = int(
             kwargs.initialization_timeout.total_seconds()
         )
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu" or (
+        os.environ.get("JAX_PLATFORM_NAME", "").strip() == "cpu"
+    ):
+        # XLA:CPU has no native cross-process collectives ("Multiprocess
+        # computations aren't implemented on the CPU backend"); the gloo
+        # transport must be selected BEFORE initialize() or every
+        # multi-process debug/elastic run dies at its first collective.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older jax: option absent, single-host paths still work
     try:
         jax.distributed.initialize(
             coordinator_address=coord, num_processes=num, process_id=pid, **extra
@@ -320,6 +331,19 @@ class AcceleratorState:
         self.mesh = build_mesh(self.parallelism_plugin)
         self.data_axis_names = data_axes(self.mesh)
         self.data_parallel_size = mesh_axis_size(self.mesh, *self.data_axis_names)
+
+    def reform_mesh(self, devices: Optional[Iterable[jax.Device]] = None):
+        """Rebuild the device mesh from an explicit device set (the elastic
+        survivor path: after a relaunch at a smaller world size, or — in
+        tests — to model a shrunken fleet on a device subset). ``-1`` auto
+        axes in the parallelism plugin re-resolve against the new device
+        count; fixed axes that no longer divide it raise, same as at init.
+        Returns the new mesh; derived data-axis bookkeeping is refreshed."""
+        devices = list(devices) if devices is not None else None
+        self.mesh = build_mesh(self.parallelism_plugin, devices=devices)
+        self.data_axis_names = data_axes(self.mesh)
+        self.data_parallel_size = mesh_axis_size(self.mesh, *self.data_axis_names)
+        return self.mesh
 
     @property
     def initialized(self) -> bool:

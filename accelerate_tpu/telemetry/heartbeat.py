@@ -41,6 +41,20 @@ def _default_process_index() -> int:
         return 0
 
 
+def _default_generation() -> Optional[int]:
+    """The elastic generation this process belongs to (the supervisor
+    exports it on every relaunch) — stamped into heartbeat records so a
+    scanner can ignore stale files left by a previous, smaller/larger
+    world without racing file deletion."""
+    from ..utils.constants import ENV_PREFIX
+
+    val = os.environ.get(ENV_PREFIX + "ELASTIC_GENERATION")
+    try:
+        return int(val) if val is not None else None
+    except ValueError:
+        return None
+
+
 class HeartbeatMonitor:
     """Watchdog for the step loop of one process.
 
@@ -59,6 +73,7 @@ class HeartbeatMonitor:
         stall_timeout_s: float = 300.0,
         process_index: Optional[int] = None,
         on_stall: Optional[Callable[["HeartbeatMonitor"], None]] = None,
+        generation: Optional[int] = None,
     ):
         if stall_timeout_s <= 0:
             raise ValueError("stall_timeout_s must be > 0")
@@ -67,6 +82,9 @@ class HeartbeatMonitor:
         self.stall_timeout_s = stall_timeout_s
         self.process_index = (
             _default_process_index() if process_index is None else process_index
+        )
+        self.generation = (
+            _default_generation() if generation is None else generation
         )
         self.on_stall = on_stall
         self.stalls = 0  # completed stall episodes observed
@@ -151,6 +169,8 @@ class HeartbeatMonitor:
             "time_unix": time.time(),
             "stalled": self._stalled,
         }
+        if self.generation is not None:
+            record["generation"] = self.generation
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w") as f:
@@ -225,3 +245,34 @@ def scan_heartbeats(
         record["stale"] = bool(record.get("stalled")) or age > stall_timeout_s
         out[int(record.get("process_index", -1))] = record
     return out
+
+
+def partition_liveness(
+    dir: str,
+    stall_timeout_s: float = 300.0,
+    generation: Optional[int] = None,
+    world: Optional[int] = None,
+) -> tuple[set[int], set[int]]:
+    """``(alive, dead)`` rank sets from the heartbeat files — the elastic
+    supervisor's declare-a-rank-dead primitive.
+
+    ``generation`` filters out files written by a previous elastic
+    generation (a relaunched, renumbered world must not count its
+    predecessor's ranks). ``world`` caps the rank range and counts ranks
+    that have never written a heartbeat as dead — a process wedged before
+    its first beat is as gone as one that stopped beating.
+    """
+    records = scan_heartbeats(dir, stall_timeout_s=stall_timeout_s)
+    if generation is not None:
+        records = {
+            r: rec
+            for r, rec in records.items()
+            if rec.get("generation") == generation
+        }
+    if world is not None:
+        records = {r: rec for r, rec in records.items() if 0 <= r < world}
+    alive = {r for r, rec in records.items() if not rec["stale"]}
+    dead = {r for r, rec in records.items() if rec["stale"]}
+    if world is not None:
+        dead |= set(range(world)) - alive - dead
+    return alive, dead

@@ -90,3 +90,11 @@ def path_in_accelerate_package(*components: str) -> str:
     import accelerate_tpu
 
     return os.path.join(os.path.dirname(accelerate_tpu.__file__), *components)
+
+
+from .fault_injection import (  # noqa: E402
+    FAULT_ENV,
+    FaultInjector,
+    FaultSpec,
+    render_specs,
+)

@@ -1,0 +1,139 @@
+"""Deterministic fault injection for elastic/fault-tolerance tests.
+
+A fault-tolerance claim is only as good as the fault that exercised it.
+This module turns "rank 2 dies at step 7" into an env-var contract so a
+supervisor test can inject EXACT failures into unmodified training
+scripts:
+
+    ACCELERATE_TPU_FAULT_INJECT="kill@7:rank=2:gen=0"
+
+Spec grammar (``;``-separated specs, each ``action@step[:key=val...]``):
+
+* ``action``: ``kill`` (SIGKILL self — a hardware loss: no handlers, no
+  final checkpoint), ``sigterm`` / ``sigint`` (delivered to self — the
+  preemption path, handlers DO run), ``hang`` (sleep forever — the wedged
+  rank the heartbeat watchdog exists for).
+* ``@step``: fire when :meth:`FaultInjector.maybe_fire` is called with
+  exactly this step.
+* ``rank=R`` (default 0): only this process index fires.
+* ``gen=G`` (default 0): only this elastic generation fires — a restarted
+  survivor world re-reads the same env, so without the gate the fault
+  would re-fire every generation and the run could never finish.
+
+The training script calls ``injector.maybe_fire(step)`` once per step
+(no-op when the env var is unset, so the call can live in shipped test
+scripts permanently).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+from typing import Optional, Sequence
+
+from ..utils.constants import ENV_PREFIX
+
+FAULT_ENV = ENV_PREFIX + "FAULT_INJECT"
+
+_ACTIONS = ("kill", "sigterm", "sigint", "hang")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One parsed fault: ``action@step:rank=R:gen=G``."""
+
+    action: str
+    step: int
+    rank: int = 0
+    generation: int = 0
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        head, _, tail = text.strip().partition(":")
+        action, at, step = head.partition("@")
+        if action not in _ACTIONS or at != "@":
+            raise ValueError(
+                f"bad fault spec {text!r}: want 'action@step[:rank=R][:gen=G]' "
+                f"with action in {_ACTIONS}"
+            )
+        fields = {"rank": 0, "gen": 0}
+        for part in filter(None, tail.split(":")):
+            key, eq, val = part.partition("=")
+            if key not in fields or eq != "=":
+                raise ValueError(
+                    f"bad fault spec {text!r}: unknown field {part!r}"
+                )
+            fields[key] = int(val)
+        return cls(
+            action=action,
+            step=int(step),
+            rank=fields["rank"],
+            generation=fields["gen"],
+        )
+
+    def render(self) -> str:
+        return f"{self.action}@{self.step}:rank={self.rank}:gen={self.generation}"
+
+
+def render_specs(specs: Sequence[FaultSpec]) -> str:
+    """Env-var value for a list of specs (the supervisor-test encoder)."""
+    return ";".join(s.render() for s in specs)
+
+
+class FaultInjector:
+    """Fires the matching :class:`FaultSpec` at the matching step.
+
+    ``rank``/``generation`` default from the process env (the same
+    ``ACCELERATE_TPU_PROCESS_ID`` / ``ACCELERATE_TPU_ELASTIC_GENERATION``
+    the launcher/supervisor export), so ``FaultInjector.from_env()`` in
+    the training script needs no plumbing.
+    """
+
+    def __init__(
+        self,
+        specs: Sequence[FaultSpec] = (),
+        rank: Optional[int] = None,
+        generation: Optional[int] = None,
+    ):
+        self.specs = list(specs)
+        if rank is None:
+            rank = int(os.environ.get(ENV_PREFIX + "PROCESS_ID", "0"))
+        if generation is None:
+            generation = int(
+                os.environ.get(ENV_PREFIX + "ELASTIC_GENERATION", "0")
+            )
+        self.rank = rank
+        self.generation = generation
+        self._fired: set[FaultSpec] = set()
+
+    @classmethod
+    def from_env(cls, env_var: str = FAULT_ENV, **kwargs) -> "FaultInjector":
+        raw = os.environ.get(env_var, "")
+        specs = [FaultSpec.parse(p) for p in raw.split(";") if p.strip()]
+        return cls(specs, **kwargs)
+
+    def maybe_fire(self, step: int) -> None:
+        """Call once per step; executes at most once per matching spec."""
+        for spec in self.specs:
+            if spec in self._fired:
+                continue
+            if (
+                spec.step == step
+                and spec.rank == self.rank
+                and spec.generation == self.generation
+            ):
+                self._fired.add(spec)
+                self._execute(spec)
+
+    def _execute(self, spec: FaultSpec) -> None:
+        if spec.action == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif spec.action == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif spec.action == "sigint":
+            os.kill(os.getpid(), signal.SIGINT)
+        elif spec.action == "hang":
+            while True:  # the watchdog's job is to notice this
+                time.sleep(3600.0)

@@ -1,0 +1,197 @@
+"""Elastic end-to-end training worker (driven by the ElasticSupervisor).
+
+One rank of a deliberately topology-independent training run:
+
+* the GLOBAL batch for optimizer step ``s`` is a pure function of ``s``
+  (every rank computes the full batch from a step-seeded numpy generator
+  and slices its own rows), so the training trajectory is identical at
+  ANY world size — which is what lets the elastic test assert
+  bitwise-identical state between a fault-interrupted run that re-formed
+  at 3 survivors and a clean run launched at 3 from the same checkpoint;
+* params are fsdp-sharded over the whole world (``fsdp_size=-1``) with
+  leaf dims divisible by every world size the tests use (1..4, 6), so a
+  checkpoint saved at world N re-slices cleanly onto world M;
+* :class:`CheckpointManager` provides cadence checkpoints + the
+  SIGTERM/SIGINT final-checkpoint contract, and its ``restore_or_init``
+  (with the supervisor's ``ACCELERATE_TPU_ELASTIC=1`` in the env)
+  performs the reshaped restore on relaunch;
+* a :class:`FaultInjector` fires whatever the test encoded in
+  ``ACCELERATE_TPU_FAULT_INJECT``.
+
+Every rank drops evidence into the project dir for the test to assert
+on: ``metrics-gen{g}-rank{r}.jsonl`` (per-step loss),
+``digest-restore-gen{g}-rank{r}.json`` / ``digest-final-gen{g}-rank{r}.json``
+(sha256 of every params/opt-state leaf, computed on the ALLGATHERED
+global value so digests are comparable across topologies), and a
+``DONE-rank{r}`` marker on clean completion.
+
+Env contract (beyond the launcher's usual):
+``ELASTIC_TEST_DIR`` project dir (required);
+``ELASTIC_TEST_STEPS`` target optimizer steps (default 15);
+``ELASTIC_TEST_EVERY`` checkpoint cadence (default 5).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import sys
+
+
+def _digests(tree) -> dict:
+    """sha256 of each leaf's GLOBAL value (allgathered) — topology-free."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = jax.tree_util.keystr(path)
+        full = np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+        out[name] = hashlib.sha256(
+            full.tobytes() + str(full.shape).encode() + str(full.dtype).encode()
+        ).hexdigest()
+    return out
+
+
+def main() -> int:
+    import numpy as np
+
+    workdir = os.environ["ELASTIC_TEST_DIR"]
+    target_steps = int(os.environ.get("ELASTIC_TEST_STEPS", "15"))
+    every = int(os.environ.get("ELASTIC_TEST_EVERY", "5"))
+    generation = int(
+        os.environ.get("ACCELERATE_TPU_ELASTIC_GENERATION", "0")
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismPlugin
+    from accelerate_tpu.fault_tolerance import CheckpointManager
+    from accelerate_tpu.telemetry.heartbeat import HeartbeatMonitor
+    from accelerate_tpu.test_utils.fault_injection import FaultInjector
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=workdir, automatic_checkpoint_naming=True
+        ),
+        parallelism_plugin=ParallelismPlugin(
+            dp_size=1, fsdp_size=-1, min_weight_size=1
+        ),
+    )
+    rank, world = acc.process_index, acc.num_processes
+
+    rng = np.random.default_rng(0)
+    params = acc.prepare(
+        {
+            "w": jnp.asarray(rng.normal(size=(12, 12)), jnp.float32),
+            "b": jnp.asarray(np.zeros((12,)), jnp.float32),
+        }
+    )
+    opt = acc.prepare(optax.adam(5e-2))
+    carry = acc.init_carry(params, opt)
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"] + p["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    step_fn = acc.unified_step(loss_fn)
+
+    heartbeat_dir = os.environ.get("ACCELERATE_TPU_ELASTIC_HEARTBEAT_DIR")
+    heartbeat = None
+    if heartbeat_dir:
+        heartbeat = HeartbeatMonitor(
+            dir=heartbeat_dir, interval_s=0.05, stall_timeout_s=3600.0
+        ).start()
+        heartbeat.beat(0)  # announce liveness before the first (slow) step
+
+    injector = FaultInjector.from_env()
+    manager = CheckpointManager(
+        acc,
+        every_n_steps=every,
+        heartbeat=heartbeat,
+        signals=(signal.SIGTERM, signal.SIGINT),
+    )
+
+    carry, resumed = manager.restore_or_init(carry)
+    acc.sync_from_carry(carry)
+    if resumed:
+        with open(
+            os.path.join(workdir, f"digest-restore-gen{generation}-rank{rank}.json"),
+            "w",
+        ) as f:
+            json.dump(
+                {"step": acc.step, "world": world, "digests": _digests(carry)},
+                f,
+            )
+
+    w_true = np.asarray(
+        np.random.default_rng(7).normal(size=(12, 12)), np.float32
+    )
+
+    def global_batch(opt_step: int):
+        """Same 12-sample global batch on every rank; slice local rows."""
+        g = np.random.default_rng(1000 + opt_step)
+        x = np.asarray(g.normal(size=(12, 12)), np.float32)
+        y = x @ w_true
+        axes = tuple(acc.state.data_axis_names)
+        spec = jax.sharding.PartitionSpec(axes if axes else None)
+        sharding = jax.sharding.NamedSharding(acc.mesh, spec)
+        per = x.shape[0] // world
+        lo, hi = rank * per, (rank + 1) * per
+        if world > 1:
+            return {
+                "x": jax.make_array_from_process_local_data(sharding, x[lo:hi]),
+                "y": jax.make_array_from_process_local_data(sharding, y[lo:hi]),
+            }
+        return {
+            "x": jax.device_put(x, sharding),
+            "y": jax.device_put(y, sharding),
+        }
+
+    metrics_path = os.path.join(
+        workdir, f"metrics-gen{generation}-rank{rank}.jsonl"
+    )
+    import numpy as _np
+
+    start = int(_np.asarray(jax.device_get(carry["opt_step"])))
+    for opt_step in range(start, target_steps):
+        carry, metrics = step_fn(carry, global_batch(opt_step))
+        loss = float(_np.asarray(jax.device_get(metrics["loss"])))
+        with open(metrics_path, "a") as f:
+            f.write(json.dumps({"step": opt_step, "loss": loss}) + "\n")
+        manager.step(carry)
+        if manager.should_stop:
+            manager.close()
+            return 0
+        # fire AFTER the cadence save so a committed checkpoint precedes
+        # the injected death (the restart must have somewhere to resume)
+        injector.maybe_fire(opt_step)
+
+    with open(
+        os.path.join(workdir, f"digest-final-gen{generation}-rank{rank}.json"),
+        "w",
+    ) as f:
+        json.dump(
+            {
+                "step": int(_np.asarray(jax.device_get(carry["opt_step"]))),
+                "world": world,
+                "digests": _digests(carry),
+            },
+            f,
+        )
+    with open(os.path.join(workdir, f"DONE-rank{rank}"), "w") as f:
+        f.write("ok\n")
+    manager.close()
+    if heartbeat is not None:
+        heartbeat.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,621 @@
+"""Elastic training tests: fault injection, survivor re-formation, and
+topology-independent restore (the three halves of the elasticity story).
+
+The fast tests exercise each piece in isolation — spec grammar, injector
+gating, liveness partitioning, the supervisor's generation loop with
+trivial python children, the topology gate on an in-process checkpoint,
+and the diagnose restartability verdict. The slow ``test_elastic_kill_
+and_reform`` is the end-to-end acceptance: a 4-process CPU run loses
+rank 2 to an injected SIGKILL mid-run, the supervisor re-forms at 3
+survivors, the relaunch performs a reshaped restore, and the finished
+state is BITWISE identical to a clean 3-process run resumed from the
+same checkpoint (``make elastic-smoke``).
+"""
+
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.commands.elastic import ElasticSupervisor
+from accelerate_tpu.test_utils.fault_injection import (
+    FAULT_ENV,
+    FaultInjector,
+    FaultSpec,
+    render_specs,
+)
+
+ENV = "ACCELERATE_TPU_"
+
+
+# ---------------------------------------------------------------------- #
+# fault spec grammar
+# ---------------------------------------------------------------------- #
+def test_fault_spec_parse_and_render_roundtrip():
+    spec = FaultSpec.parse("kill@7:rank=2:gen=1")
+    assert spec == FaultSpec(action="kill", step=7, rank=2, generation=1)
+    assert FaultSpec.parse(spec.render()) == spec
+
+
+def test_fault_spec_defaults_rank0_gen0():
+    assert FaultSpec.parse("hang@3") == FaultSpec("hang", 3, rank=0, generation=0)
+
+
+@pytest.mark.parametrize(
+    "bad", ["explode@3", "kill", "kill@3:world=2", "kill@3:rank2"]
+)
+def test_fault_spec_rejects_bad_grammar(bad):
+    with pytest.raises(ValueError, match="bad fault spec"):
+        FaultSpec.parse(bad)
+
+
+def test_render_specs_joins_with_semicolons():
+    text = render_specs([FaultSpec("kill", 7, 2, 0), FaultSpec("hang", 9)])
+    assert text == "kill@7:rank=2:gen=0;hang@9:rank=0:gen=0"
+    parsed = [FaultSpec.parse(p) for p in text.split(";")]
+    assert parsed == [FaultSpec("kill", 7, 2, 0), FaultSpec("hang", 9, 0, 0)]
+
+
+# ---------------------------------------------------------------------- #
+# injector gating
+# ---------------------------------------------------------------------- #
+def test_injector_fires_once_on_matching_rank_and_generation():
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda *a: hits.append(a))
+    try:
+        spec = FaultSpec("sigterm", 3, rank=1, generation=0)
+        wrong_rank = FaultInjector([spec], rank=0, generation=0)
+        wrong_gen = FaultInjector([spec], rank=1, generation=1)
+        match = FaultInjector([spec], rank=1, generation=0)
+        for step in range(5):
+            wrong_rank.maybe_fire(step)
+            wrong_gen.maybe_fire(step)
+        assert hits == []
+        match.maybe_fire(2)
+        assert hits == []
+        match.maybe_fire(3)
+        assert len(hits) == 1
+        match.maybe_fire(3)  # fired set: never re-fires
+        assert len(hits) == 1
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_injector_from_env(monkeypatch):
+    monkeypatch.setenv(FAULT_ENV, "sigterm@5;hang@9:rank=2:gen=1")
+    inj = FaultInjector.from_env(rank=0, generation=0)
+    assert inj.specs == [
+        FaultSpec("sigterm", 5, 0, 0),
+        FaultSpec("hang", 9, 2, 1),
+    ]
+    monkeypatch.delenv(FAULT_ENV)
+    empty = FaultInjector.from_env(rank=0, generation=0)
+    assert empty.specs == []
+    empty.maybe_fire(5)  # no-op, safe to leave in shipped scripts
+
+
+def test_injector_rank_and_generation_default_from_env(monkeypatch):
+    monkeypatch.setenv(ENV + "PROCESS_ID", "3")
+    monkeypatch.setenv(ENV + "ELASTIC_GENERATION", "2")
+    inj = FaultInjector([])
+    assert inj.rank == 3 and inj.generation == 2
+
+
+# ---------------------------------------------------------------------- #
+# liveness partitioning (the supervisor's death-declaration input)
+# ---------------------------------------------------------------------- #
+def _write_heartbeat(dir, rank, generation, age_s=0.0, step=1):
+    with open(os.path.join(dir, f"heartbeat-rank{rank}.json"), "w") as f:
+        json.dump(
+            {
+                "process_index": rank,
+                "pid": 1000 + rank,
+                "step": step,
+                "time_unix": time.time() - age_s,
+                "stalled": False,
+                "generation": generation,
+            },
+            f,
+        )
+
+
+def test_partition_liveness_filters_stale_and_old_generations(tmp_path):
+    from accelerate_tpu.telemetry.heartbeat import partition_liveness
+
+    d = str(tmp_path)
+    _write_heartbeat(d, 0, generation=1, age_s=0.0)  # fresh, right gen
+    _write_heartbeat(d, 1, generation=1, age_s=100.0)  # stale
+    _write_heartbeat(d, 2, generation=0, age_s=0.0)  # previous generation
+    alive, dead = partition_liveness(
+        d, stall_timeout_s=5.0, generation=1, world=3
+    )
+    assert alive == {0}
+    # rank 1 went silent; rank 2 never beat in THIS generation — a
+    # renumbered world must not count a predecessor's file as liveness
+    assert dead == {1, 2}
+
+
+# ---------------------------------------------------------------------- #
+# supervisor generation loop (plain-python children: no jax, no mesh)
+# ---------------------------------------------------------------------- #
+def _supervisor(code, tmp_path, **kwargs):
+    defaults = dict(
+        heartbeat_dir=str(tmp_path / "hb"),
+        stall_timeout_s=0,  # exit-code detection only (no heartbeats here)
+        grace_period_s=2.0,
+        monitor_interval_s=0.02,
+        cpu=False,
+    )
+    defaults.update(kwargs)
+    return ElasticSupervisor([sys.executable, "-c", code], **defaults)
+
+
+def _events(sup):
+    path = os.path.join(sup.heartbeat_dir, "elastic-events.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_supervisor_all_clean_is_one_successful_generation(tmp_path):
+    sup = _supervisor("import os; os.environ['%sPROCESS_ID']" % ENV,
+                      tmp_path, num_processes=3)
+    assert sup.run() == 0
+    assert [r.outcome for r in sup.history] == ["success"]
+    rec = sup.history[0]
+    assert rec.world == 3 and rec.dead_ranks == []
+    assert set(rec.exit_codes.values()) == {0}
+    # per-rank logs exist (post-mortems need un-interleaved output)
+    for rank in range(3):
+        assert os.path.isfile(
+            os.path.join(sup.heartbeat_dir, f"rank{rank}-gen0.log")
+        )
+    assert any(e["event"] == "run_complete" for e in _events(sup))
+
+
+def test_supervisor_rank_death_reforms_with_survivors(tmp_path):
+    code = (
+        "import os, sys\n"
+        f"r = int(os.environ['{ENV}PROCESS_ID'])\n"
+        f"g = int(os.environ['{ENV}ELASTIC_GENERATION'])\n"
+        f"assert os.environ['{ENV}ELASTIC'] == '1'\n"
+        f"assert int(os.environ['{ENV}RESTART_COUNT']) == g\n"
+        "sys.exit(1 if (r == 1 and g == 0) else 0)\n"
+    )
+    hook_calls = []
+    sup = _supervisor(
+        code, tmp_path, num_processes=3, min_processes=2,
+        generation_hook=lambda g, w: hook_calls.append((g, w)),
+    )
+    assert sup.run() == 0
+    assert [r.outcome for r in sup.history] == ["rank_death", "success"]
+    assert sup.history[0].dead_ranks == [1]
+    assert sup.history[0].exit_codes[1] == 1
+    # survivors renumber into a CONTIGUOUS smaller world
+    assert sup.history[1].world == 2
+    assert hook_calls == [(0, 3), (1, 2)]
+    kinds = [e["event"] for e in _events(sup)]
+    assert "rank_death" in kinds and "reforming" in kinds
+    reform = next(e for e in _events(sup) if e["event"] == "reforming")
+    assert reform["old_world"] == 3 and reform["new_world"] == 2
+
+
+def test_supervisor_below_min_gives_up(tmp_path):
+    sup = _supervisor("import sys; sys.exit(1)", tmp_path,
+                      num_processes=2, min_processes=2)
+    assert sup.run() == 1
+    assert sup.history[-1].outcome == "below_min"
+    assert any(e["event"] == "giving_up" for e in _events(sup))
+
+
+def test_supervisor_heartbeat_declares_hung_rank_dead(tmp_path):
+    """A rank that beats once then wedges (no exit, no more beats) must be
+    declared dead by heartbeat staleness and the run re-formed without it."""
+    code = (
+        "import json, os, sys, time\n"
+        f"r = int(os.environ['{ENV}PROCESS_ID'])\n"
+        f"g = int(os.environ['{ENV}ELASTIC_GENERATION'])\n"
+        f"d = os.environ['{ENV}ELASTIC_HEARTBEAT_DIR']\n"
+        "with open(os.path.join(d, 'heartbeat-rank%d.json' % r), 'w') as f:\n"
+        "    json.dump({'process_index': r, 'pid': os.getpid(), 'step': 1,\n"
+        "               'time_unix': time.time(), 'stalled': False,\n"
+        "               'generation': g}, f)\n"
+        "if r == 0 and g == 0:\n"
+        "    time.sleep(120)\n"
+        "sys.exit(0)\n"
+    )
+    sup = _supervisor(
+        code, tmp_path, num_processes=3, min_processes=1,
+        stall_timeout_s=1.0, generation_timeout_s=60.0,
+    )
+    assert sup.run() == 0
+    assert [r.outcome for r in sup.history] == ["rank_death", "success"]
+    assert sup.history[0].dead_ranks == [0]
+    assert sup.history[1].world == 2
+    death = next(e for e in _events(sup) if e["event"] == "heartbeat_death")
+    assert death["rank"] == 0 and death["generation"] == 0
+
+
+def test_supervisor_generation_timeout_kills_hung_world(tmp_path):
+    sup = _supervisor(
+        "import time; time.sleep(120)", tmp_path,
+        num_processes=1, min_processes=1, generation_timeout_s=0.5,
+    )
+    assert sup.run() == 1
+    assert sup.history[0].dead_ranks == [0]
+    assert any(e["event"] == "generation_timeout" for e in _events(sup))
+
+
+def test_supervisor_validates_bounds(tmp_path):
+    with pytest.raises(ValueError):
+        ElasticSupervisor(["true"], num_processes=0)
+    with pytest.raises(ValueError, match="min_processes"):
+        ElasticSupervisor(["true"], num_processes=2, min_processes=3)
+
+
+# ---------------------------------------------------------------------- #
+# topology gate + non-sliceable-state re-derivation (in-process)
+# ---------------------------------------------------------------------- #
+def _edit_topology(ck_dir, **changes):
+    path = os.path.join(ck_dir, "topology.json")
+    with open(path) as f:
+        topo = json.load(f)
+    topo.update(changes)
+    with open(path, "w") as f:
+        json.dump(topo, f)
+    return topo
+
+
+def _fresh_accelerator(tmp_path, **acc_kwargs):
+    from accelerate_tpu import Accelerator, ProjectConfiguration
+    from accelerate_tpu.state import (
+        AcceleratorState,
+        GradientState,
+        PartialState,
+    )
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    return Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True
+        ),
+        **acc_kwargs,
+    )
+
+
+def _zero_like(carry):
+    def _zero(x):
+        z = jnp.zeros(x.shape, x.dtype)
+        if isinstance(
+            getattr(x, "sharding", None), jax.sharding.NamedSharding
+        ):
+            z = jax.device_put(z, x.sharding)
+        return z
+
+    return jax.tree.map(_zero, carry)
+
+
+def test_mismatched_topology_refuses_without_allow_reshape(tmp_path):
+    import optax
+
+    acc = _fresh_accelerator(tmp_path)
+    params = acc.prepare({"w": jnp.ones((8, 8))})
+    opt = acc.prepare(optax.sgd(0.1))
+    carry = acc.init_carry(params, opt)
+    step = acc.unified_step(lambda p, b: jnp.mean(p["w"] ** 2))
+    carry, _ = step(carry, {"x": jnp.ones((4,))})
+    out = acc.save_state(carry=carry)
+
+    # a checkpoint from a 4-host fleet arriving on this 1-host world
+    _edit_topology(out, world_size=4, num_devices=4)
+
+    with pytest.raises(ValueError) as exc:
+        acc.load_state(out, carry=_zero_like(carry))
+    msg = str(exc.value)
+    # the error must name BOTH topologies and the escape hatch
+    assert "saved world_size=4" in msg
+    assert "live world_size=1" in msg
+    assert "allow_reshape" in msg
+
+    restored = acc.load_state(out, carry=_zero_like(carry),
+                              allow_reshape=True)
+    for a, b in zip(jax.tree.leaves(carry), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_elastic_env_flag_enables_reshape(tmp_path, monkeypatch):
+    import optax
+
+    acc = _fresh_accelerator(tmp_path)
+    params = acc.prepare({"w": jnp.ones((8, 8))})
+    opt = acc.prepare(optax.sgd(0.1))
+    carry = acc.init_carry(params, opt)
+    out = acc.save_state(carry=carry)
+    _edit_topology(out, world_size=2, num_devices=16)
+
+    # supervisor-relaunched processes see ACCELERATE_TPU_ELASTIC=1, so
+    # restore reshapes without every train script passing the kwarg
+    monkeypatch.setenv(ENV + "ELASTIC", "1")
+    restored = acc.load_state(out, carry=_zero_like(carry))
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.asarray(carry["params"]["w"])
+    )
+
+
+def test_matching_topology_loads_without_flag(tmp_path):
+    """Old/own-topology checkpoints keep loading exactly as before — the
+    gate only bites on an actual mismatch."""
+    import optax
+
+    acc = _fresh_accelerator(tmp_path)
+    params = acc.prepare({"w": jnp.ones((4, 4))})
+    opt = acc.prepare(optax.sgd(0.1))
+    carry = acc.init_carry(params, opt)
+    out = acc.save_state(carry=carry)
+    restored = acc.load_state(out, carry=_zero_like(carry))
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), 1.0
+    )
+    # pre-topology-metadata checkpoints (no topology.json) also load
+    os.remove(os.path.join(out, "topology.json"))
+    restored = acc.load_state(out, carry=_zero_like(carry))
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), 1.0
+    )
+
+
+def test_reshaped_restore_zeroes_mid_accumulation_remainder(tmp_path):
+    """A carry saved mid-accumulation resumes at the last optimizer-step
+    boundary on a topology change: microbatch boundaries don't map across
+    world sizes, so micro_step/accum_grads re-derive to zero."""
+    import optax
+
+    acc = _fresh_accelerator(tmp_path, gradient_accumulation_steps=2)
+    params = acc.prepare({"w": jnp.ones((4, 4))})
+    opt = acc.prepare(optax.sgd(0.1))
+    carry = acc.init_carry(params, opt, fused_accumulation=False)
+    step = acc.unified_step(
+        lambda p, b: jnp.mean((p["w"] - b["t"]) ** 2)
+    )
+    batch = {"t": jnp.zeros((4, 4))}
+    for _ in range(3):  # 2 microbatches -> opt step, 3rd leaves micro=1
+        carry, _ = step(carry, batch)
+    assert int(np.asarray(carry["micro_step"])) == 1
+    assert float(np.abs(np.asarray(carry["accum_grads"]["w"])).sum()) > 0
+    out = acc.save_state(carry=carry)
+    _edit_topology(out, world_size=2, num_devices=16)
+
+    restored = acc.load_state(out, carry=_zero_like(carry),
+                              allow_reshape=True)
+    assert int(np.asarray(restored["micro_step"])) == 0
+    np.testing.assert_array_equal(
+        np.asarray(restored["accum_grads"]["w"]), 0.0
+    )
+    # the committed (opt-step-boundary) state still restores bitwise
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.asarray(carry["params"]["w"])
+    )
+    assert int(np.asarray(restored["opt_step"])) == 1
+
+
+def test_reshaped_restore_folds_new_rank_into_keychain(tmp_path):
+    import optax
+
+    acc = _fresh_accelerator(tmp_path)
+    params = acc.prepare({"w": jnp.ones((4, 4))})
+    opt = acc.prepare(optax.sgd(0.1))
+    carry = acc.init_carry(params, opt)
+    out = acc.save_state(carry=carry)
+
+    acc.load_state(out, carry=_zero_like(carry))
+    saved_key = np.asarray(jax.random.key_data(acc.keys.key)).copy()
+    _edit_topology(out, world_size=2, num_devices=16)
+    acc.load_state(out, carry=_zero_like(carry), allow_reshape=True)
+    reshaped_key = np.asarray(jax.random.key_data(acc.keys.key))
+    # rank-0 streams + fold_in(new rank): deterministic but distinct from
+    # the saved stream, never aliased between survivor ranks
+    assert not np.array_equal(saved_key, reshaped_key)
+
+
+# ---------------------------------------------------------------------- #
+# diagnose: the restartability verdict
+# ---------------------------------------------------------------------- #
+def test_diagnose_elastic_verdict_names_reshape(tmp_path):
+    from accelerate_tpu.checkpoint_async import commit as cm
+    from accelerate_tpu.diagnostics.diagnose import build_report, format_report
+
+    d = str(tmp_path)
+    # a committed checkpoint stamped with a 4-rank save-time topology
+    ck = os.path.join(d, "checkpoint_5")
+    work = cm.work_dir_for(ck)
+    os.makedirs(work)
+    cm.commit(
+        work, ck, process_index=0, world=1,
+        topology={
+            "format_version": 1, "world_size": 4, "num_devices": 4,
+            "mesh_shape": {"dp": 4}, "step": 5,
+        },
+    )
+    # rank 0's flight dump points the report at that checkpoint
+    with open(os.path.join(d, "flightrec-rank0.json"), "w") as f:
+        json.dump(
+            {
+                "process_index": 0, "last_step": 9, "reason": "preemption",
+                "time_unix": time.time(), "dumps": 1, "records": [],
+                "last_checkpoint": {
+                    "dir": ck, "step": 5, "time_unix": time.time(),
+                },
+            },
+            f,
+        )
+    # 2 of 4 ranks still beating
+    for rank, age in [(0, 0.0), (1, 0.0), (2, 900.0), (3, 900.0)]:
+        _write_heartbeat(d, rank, generation=0, age_s=age, step=9)
+
+    report = build_report(d, stall_timeout_s=300.0)
+    elastic = report["elastic"]
+    assert elastic["survivors"] == [0, 1]
+    assert elastic["restartable"] is True
+    assert elastic["saved_topology"]["world_size"] == 4
+    assert elastic["needs_reshape"] is True
+
+    text = format_report(report)
+    assert "RESTARTABLE with 2 survivor(s) of 4" in text
+    assert "--elastic" in text and "allow_reshape" in text
+
+
+def test_diagnose_elastic_not_restartable_without_committed_checkpoint(
+    tmp_path,
+):
+    from accelerate_tpu.diagnostics.diagnose import build_report, format_report
+
+    d = str(tmp_path)
+    uncommitted = os.path.join(d, "checkpoint_3")
+    os.makedirs(uncommitted)  # no COMMITTED marker
+    with open(os.path.join(d, "flightrec-rank0.json"), "w") as f:
+        json.dump(
+            {
+                "process_index": 0, "last_step": 3, "reason": "crash",
+                "time_unix": time.time(), "dumps": 1, "records": [],
+                "last_checkpoint": {
+                    "dir": uncommitted, "step": 3, "time_unix": time.time(),
+                },
+            },
+            f,
+        )
+    _write_heartbeat(d, 0, generation=0, age_s=0.0)
+    report = build_report(d, stall_timeout_s=300.0)
+    assert report["elastic"]["restartable"] is False
+    assert "NOT restartable" in format_report(report)
+
+
+# ---------------------------------------------------------------------- #
+# end-to-end: kill a rank, re-form, finish bitwise-identical
+# ---------------------------------------------------------------------- #
+def _read_metrics(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _read_json(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+@pytest.mark.slow
+def test_elastic_kill_and_reform(tmp_path):
+    """Acceptance for the whole subsystem (also `make elastic-smoke`):
+
+    4-process CPU run, rank 2 SIGKILLed at step 7 (after the step-5
+    cadence checkpoint committed). The supervisor declares the death,
+    tears the survivors down, and relaunches 3 processes; generation 1
+    restores the 4-way checkpoint onto the 3-way mesh (reshaped) and
+    trains to completion. A CONTROL run — a clean 3-process world started
+    from a copy of exactly what generation 1 saw on disk — must produce
+    bitwise-identical restored state, per-step losses, and final state.
+    """
+    from accelerate_tpu.test_utils import path_in_accelerate_package
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = path_in_accelerate_package(
+        "test_utils", "scripts", "elastic_train.py"
+    )
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    snapshots = {}
+
+    def snapshot(generation, world):
+        # what gen g's relaunch sees on disk (the control run's seed)
+        if generation > 0:
+            dst = tmp_path / f"snap-gen{generation}"
+            shutil.copytree(proj, dst)
+            snapshots[generation] = dst
+
+    base_env = {
+        "ELASTIC_TEST_DIR": str(proj),
+        "ELASTIC_TEST_STEPS": "15",
+        "ELASTIC_TEST_EVERY": "5",
+        "PYTHONPATH": pkg_root,
+        # children must NOT inherit conftest's 8-fake-device XLA_FLAGS:
+        # each rank is one real CPU device in the multiprocess mesh
+        "XLA_FLAGS": "",
+    }
+    sup = ElasticSupervisor(
+        [sys.executable, script],
+        num_processes=4,
+        min_processes=2,
+        heartbeat_dir=str(tmp_path / "hb"),
+        stall_timeout_s=120.0,
+        grace_period_s=8.0,
+        max_generations=3,
+        generation_timeout_s=240.0,
+        generation_hook=snapshot,
+        env={**base_env, FAULT_ENV: "kill@7:rank=2:gen=0"},
+    )
+    assert sup.run() == 0, [r.to_json() for r in sup.history]
+    assert sup.history[0].outcome == "rank_death"
+    assert sup.history[0].dead_ranks == [2]
+    assert sup.history[-1].outcome == "success"
+    final_gen = sup.history[-1].generation
+    final_world = sup.history[-1].world
+    assert final_world == 3
+    for rank in range(final_world):
+        assert (proj / f"DONE-rank{rank}").exists()
+
+    # ------ control: clean 3-way run from the same on-disk state ------ #
+    ctl = tmp_path / "ctl"
+    shutil.copytree(snapshots[1], ctl)
+    # keep only the checkpoints: the control run is itself generation 0,
+    # so the elastic run's gen-0 evidence files would collide with its own
+    import glob as _glob
+
+    for pattern in ("metrics-*", "digest-*", "DONE-*"):
+        for stale in _glob.glob(str(ctl / pattern)):
+            os.remove(stale)
+    ctl_sup = ElasticSupervisor(
+        [sys.executable, script],
+        num_processes=3,
+        min_processes=3,
+        heartbeat_dir=str(tmp_path / "hb-ctl"),
+        stall_timeout_s=120.0,
+        grace_period_s=8.0,
+        max_generations=1,
+        generation_timeout_s=240.0,
+        env={**base_env, "ELASTIC_TEST_DIR": str(ctl)},
+    )
+    assert ctl_sup.run() == 0, [r.to_json() for r in ctl_sup.history]
+
+    # the reshaped restore (4 -> 3) is bitwise what a clean 3-way restore
+    # of the same checkpoint produces
+    el_restore = _read_json(proj / f"digest-restore-gen{final_gen}-rank0.json")
+    ct_restore = _read_json(ctl / "digest-restore-gen0-rank0.json")
+    assert el_restore["world"] == ct_restore["world"] == 3
+    assert el_restore["step"] == ct_restore["step"] == 5
+    assert el_restore["digests"] == ct_restore["digests"]
+
+    # ...and so is everything downstream of it: per-step losses and the
+    # final params + optimizer moments (same-topology bitwise claim)
+    el_metrics = _read_metrics(proj / f"metrics-gen{final_gen}-rank0.jsonl")
+    ct_metrics = _read_metrics(ctl / "metrics-gen0-rank0.jsonl")
+    assert el_metrics == ct_metrics
+    assert el_metrics[0]["step"] == 5 and el_metrics[-1]["step"] == 14
+    # the run actually learned across the fault boundary
+    gen0 = _read_metrics(proj / "metrics-gen0-rank0.jsonl")
+    assert el_metrics[-1]["loss"] < gen0[0]["loss"]
+
+    el_final = _read_json(proj / f"digest-final-gen{final_gen}-rank0.json")
+    ct_final = _read_json(ctl / "digest-final-gen0-rank0.json")
+    assert el_final["step"] == ct_final["step"] == 15
+    mismatched = [
+        k for k, v in el_final["digests"].items()
+        if ct_final["digests"].get(k) != v
+    ]
+    assert mismatched == []

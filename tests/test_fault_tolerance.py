@@ -170,3 +170,106 @@ def test_requires_automatic_naming():
     acc = Accelerator()  # default: no automatic naming
     with pytest.raises(ValueError, match="automatic checkpoint naming"):
         CheckpointManager(acc)
+
+
+def _fresh_run(tmp_path):
+    """A restarted process: reset singletons, rebuild the same model."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    return _setup(tmp_path)
+
+
+def test_restore_falls_back_when_newest_checkpoint_corrupt(tmp_path):
+    """A crash mid-write (or bit rot) on the newest checkpoint must not
+    strand the run: restore falls back to the next-newest committed one."""
+    import glob
+
+    acc, carry, step, batch = _setup(tmp_path)
+    with CheckpointManager(acc, every_n_steps=2, handle_signals=False) as mgr:
+        for i in range(4):
+            carry, _ = step(carry, batch)
+            mgr.step(carry)
+            if i == 1:
+                w_at_2 = np.asarray(carry["params"]["w"]).copy()
+    # corrupt the newest (checkpoint_1, step 4): lose its shard file
+    for shard in glob.glob(
+        str(tmp_path / "checkpoints" / "checkpoint_1" / "state_shard_*")
+    ):
+        os.remove(shard)
+
+    acc2, carry2, _, _ = _fresh_run(tmp_path)
+    with CheckpointManager(acc2, handle_signals=False) as mgr2:
+        carry2, resumed = mgr2.restore_or_init(carry2)
+    assert resumed
+    assert acc2.step == 2  # checkpoint_0, not the corrupt checkpoint_1
+    np.testing.assert_array_equal(np.asarray(carry2["params"]["w"]), w_at_2)
+
+
+def test_restore_raises_when_every_checkpoint_corrupt(tmp_path):
+    import glob
+
+    acc, carry, step, batch = _setup(tmp_path)
+    with CheckpointManager(acc, every_n_steps=2, handle_signals=False) as mgr:
+        for _ in range(4):
+            carry, _ = step(carry, batch)
+            mgr.step(carry)
+    for shard in glob.glob(
+        str(tmp_path / "checkpoints" / "*" / "state_shard_*")
+    ):
+        os.remove(shard)
+    acc2, carry2, _, _ = _fresh_run(tmp_path)
+    with CheckpointManager(acc2, handle_signals=False) as mgr2:
+        with pytest.raises(RuntimeError, match="every checkpoint"):
+            mgr2.restore_or_init(carry2)
+
+
+def test_sigint_opt_in_gets_preemption_semantics(tmp_path):
+    """signals=(SIGTERM, SIGINT) gives Ctrl-C the durable-stop contract;
+    WITHOUT the knob SIGINT keeps its normal KeyboardInterrupt handler."""
+    acc, carry, step, batch = _setup(tmp_path)
+    default_int = signal.getsignal(signal.SIGINT)
+    with CheckpointManager(acc, every_n_steps=1000) as mgr:
+        # default manager: SIGINT untouched, SIGTERM claimed
+        assert signal.getsignal(signal.SIGINT) is default_int
+        assert signal.getsignal(signal.SIGTERM) == mgr._on_preemption
+    with CheckpointManager(
+        acc, every_n_steps=1000,
+        signals=(signal.SIGTERM, signal.SIGINT),
+    ) as mgr:
+        assert signal.getsignal(signal.SIGINT) == mgr._on_preemption
+        carry, _ = step(carry, batch)
+        os.kill(os.getpid(), signal.SIGINT)  # no KeyboardInterrupt raised
+        assert mgr.preempted
+        carry, _ = step(carry, batch)
+        out = mgr.step(carry)
+        assert out is not None and mgr.should_stop
+    # handlers restored on close
+    assert signal.getsignal(signal.SIGINT) is default_int
+
+
+def test_close_is_idempotent_and_restores_handlers(tmp_path):
+    acc, *_ = _setup(tmp_path)
+    prev = signal.getsignal(signal.SIGTERM)
+    mgr = CheckpointManager(acc, every_n_steps=1000)
+    assert signal.getsignal(signal.SIGTERM) == mgr._on_preemption
+    mgr.close()
+    assert signal.getsignal(signal.SIGTERM) is prev
+    mgr.close()  # second close (e.g. the atexit hook after __exit__): no-op
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_close_does_not_clobber_newer_handler(tmp_path):
+    """Closing an OLD manager while a newer one owns the signal must leave
+    the newer handler installed (un-install only your own handler)."""
+    acc, *_ = _setup(tmp_path)
+    prev = signal.getsignal(signal.SIGTERM)
+    m1 = CheckpointManager(acc, every_n_steps=1000)
+    m2 = CheckpointManager(acc, every_n_steps=1000)
+    assert signal.getsignal(signal.SIGTERM) == m2._on_preemption
+    m1.close()
+    assert signal.getsignal(signal.SIGTERM) == m2._on_preemption
+    m2.close()
+    signal.signal(signal.SIGTERM, prev)  # unwind the nested install
