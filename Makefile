@@ -31,10 +31,11 @@ FAST_FILES = \
   tests/test_checkpoint_async.py tests/test_fused_accum.py \
   tests/test_diagnostics.py tests/test_benchmarks.py \
   tests/test_serving.py tests/test_serving_obs.py \
-  tests/test_elastic.py
+  tests/test_elastic.py tests/test_fused_kernels.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
-  diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke
+  diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke \
+  kernels-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -117,6 +118,20 @@ serve-obs-smoke:
 elastic-smoke:
 	JAX_PLATFORMS=cpu $(PYTEST) -q \
 	  tests/test_elastic.py::test_elastic_kill_and_reform
+
+# step-speed kernel acceptance on CPU (<120s): interpret-mode Pallas
+# prologue matches the reference chain (values + grads), the fused adamw
+# epilogue is BITWISE against the production optax tail with a traced
+# clip scale, and a fused-kernels model takes zero retraces after
+# warmup; then the dense bench variant emits the fused-vs-unfused A/B
+# (on CPU interpret mode the unfused pass headlines — the A/B numbers
+# are the acceptance artifact, the speedup claim is TPU-only)
+kernels-smoke:
+	$(PYTEST) -q \
+	  tests/test_fused_kernels.py::test_prologue_kernel_matches_reference \
+	  tests/test_fused_kernels.py::test_epilogue_kernel_bitwise_vs_reference \
+	  tests/test_fused_kernels.py::test_zero_retraces_after_warmup_with_fused_kernels
+	python bench.py dense
 
 # diagnostics end-to-end on CPU: a tiny train loop with an injected slow
 # step and an injected NaN gradient runs with the flight recorder on,

@@ -43,6 +43,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .data_loader import DataLoaderShard, prepare_data_loader, skip_first_batches
 from .logging import get_logger
+from .ops.fused import maybe_fused_epilogue
 from .optimizer import (
     AcceleratedOptimizer,
     LossScaleState,
@@ -140,6 +141,24 @@ class Accelerator:
 
             self.state.compile_cache_dir = activate_persistent_cache(
                 self.compile_plugin
+            )
+        if self.compile_plugin.overlap_collectives is not False:
+            # collective/compute overlap (compilation/overlap.py): emit
+            # the async-collective + latency-hiding-scheduler XLA options
+            # into the compiler_options hook. {} on CPU and on layouts
+            # with no per-step collectives; explicit user options win.
+            from .compilation.overlap import (
+                merge_compiler_options,
+                overlap_options,
+            )
+
+            force = self.compile_plugin.overlap_collectives is True
+            auto = overlap_options(
+                None if force else self.state.parallelism_plugin,
+                None if force else self.mesh,
+            )
+            self.compile_plugin.compiler_options = merge_compiler_options(
+                auto, self.compile_plugin.compiler_options
             )
         self.gradient_state = GradientState(gradient_accumulation_plugin)
         self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
@@ -492,12 +511,31 @@ class Accelerator:
             mean_grads, finite, new_ls = unscale_and_check(
                 mean_grads, ls, policy
             )
-            if max_grad_norm is not None:
-                gnorm = optax.global_norm(mean_grads)
-                scale_c = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+            gnorm = optax.global_norm(mean_grads)
+            scale_c = (
+                jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                if max_grad_norm is not None
+                else None
+            )
+            # fused epilogue (ops/fused.py): when the optimizer is a
+            # fused_adamw, the clip-mult -> moment update -> apply ->
+            # overflow-hold tail runs as one Pallas kernel per leaf —
+            # bitwise fp32 parity with the optax chain below
+            fused_out = maybe_fused_epilogue(
+                opt_transform, mean_grads, opt_state, params,
+                clip_scale=scale_c, finite=finite,
+            )
+            if fused_out is not None:
+                new_params, new_opt_state = fused_out
+                new_params = _pin_to_shardings(
+                    new_params, self._param_shardings
+                )
+                new_opt_state = _pin_to_shardings(
+                    new_opt_state, _opt_shardings()
+                )
+                return new_params, new_opt_state, new_ls, gnorm, finite
+            if scale_c is not None:
                 mean_grads = jax.tree.map(lambda g: g * scale_c, mean_grads)
-            else:
-                gnorm = optax.global_norm(mean_grads)
             updates, new_opt_state = opt_transform.update(
                 mean_grads, opt_state, params
             )
@@ -689,16 +727,24 @@ class Accelerator:
         # legitimately see different signatures without cross-talk warnings
         tel_label = f"unified_step#{self._built_steps}"
         self._built_steps += 1
+        # telemetry: the step runs Pallas-fused kernels if the model opted
+        # into the fused prologue (loss_fn built from a fused_kernels=True
+        # config tags itself) or the optimizer carries the fused epilogue
+        fused_tel = bool(getattr(loss_fn, "fused_kernels", False)) or bool(
+            getattr(opt_transform, "fused", False)
+        )
         if fused:
             # every call IS an optimizer step: one dispatch covers all K
             # microbatches, so the wrapper emits one record per opt step
             return self._wrap_step(
                 jitted, tel_label, sync_every=1,
                 microbatches=num_accum, dispatches=1,
+                fused_kernels=fused_tel,
             )
         return self._wrap_step(
             jitted, tel_label, sync_every=num_accum,
             microbatches=1, dispatches=num_accum,
+            fused_kernels=fused_tel,
         )
 
     def unified_pipeline_step(
@@ -775,25 +821,49 @@ class Accelerator:
             # semantics to unified_step's sync boundary)
             grads, finite, new_ls = unscale_and_check(grads, ls, policy)
             gnorm = optax.global_norm(grads)
-            if max_grad_norm is not None:
-                scale_c = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
-                grads = jax.tree.map(lambda g: g * scale_c, grads)
-            updates, new_opt_state = opt_transform.update(
-                grads, opt_state, params
+            scale_c = (
+                jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                if max_grad_norm is not None
+                else None
             )
-            new_params = optax.apply_updates(params, updates)
-            new_params = _pin_to_shardings(new_params, self._param_shardings)
-            new_opt_state = _pin_to_shardings(new_opt_state, _opt_shardings())
-            if ls is not None:
-                # overflow: hold params/opt-state (GradScaler skip), halve
-                # the scale via new_ls
-                new_params = jax.tree.map(
-                    lambda n, o: jnp.where(finite, n, o), new_params, params
+            # same fused-epilogue seam as unified_step's _sync_apply:
+            # one Pallas kernel per leaf when the optimizer opted in
+            fused_out = maybe_fused_epilogue(
+                opt_transform, grads, opt_state, params,
+                clip_scale=scale_c, finite=finite,
+            )
+            if fused_out is not None:
+                new_params, new_opt_state = fused_out
+                new_params = _pin_to_shardings(
+                    new_params, self._param_shardings
                 )
-                new_opt_state = jax.tree.map(
-                    lambda n, o: jnp.where(finite, n, o), new_opt_state,
-                    opt_state,
+                new_opt_state = _pin_to_shardings(
+                    new_opt_state, _opt_shardings()
                 )
+            else:
+                if scale_c is not None:
+                    grads = jax.tree.map(lambda g: g * scale_c, grads)
+                updates, new_opt_state = opt_transform.update(
+                    grads, opt_state, params
+                )
+                new_params = optax.apply_updates(params, updates)
+                new_params = _pin_to_shardings(
+                    new_params, self._param_shardings
+                )
+                new_opt_state = _pin_to_shardings(
+                    new_opt_state, _opt_shardings()
+                )
+                if ls is not None:
+                    # overflow: hold params/opt-state (GradScaler skip),
+                    # halve the scale via new_ls
+                    new_params = jax.tree.map(
+                        lambda n, o: jnp.where(finite, n, o), new_params,
+                        params,
+                    )
+                    new_opt_state = jax.tree.map(
+                        lambda n, o: jnp.where(finite, n, o), new_opt_state,
+                        opt_state,
+                    )
             new_carry = {
                 **carry,
                 "params": new_params,
@@ -823,7 +893,9 @@ class Accelerator:
         # every pipeline step is an optimizer step -> sync_every=1; the 1F1B
         # schedule IS the microbatching, folded into the single dispatch
         return self._wrap_step(
-            jitted, tel_label, sync_every=1, microbatches=num_micro, dispatches=1
+            jitted, tel_label, sync_every=1, microbatches=num_micro,
+            dispatches=1,
+            fused_kernels=bool(getattr(opt_transform, "fused", False)),
         )
 
     def _wrap_step(
@@ -834,6 +906,7 @@ class Accelerator:
         sync_every: int,
         microbatches: int = 1,
         dispatches: int = 1,
+        fused_kernels: bool = False,
     ) -> Callable:
         """The shared step-fn wrapper: host-mirror bookkeeping, telemetry,
         compile-cost attribution, and the AOT warmup fast path.
@@ -914,6 +987,7 @@ class Accelerator:
                     extra={
                         "microbatches": microbatches,
                         "dispatches_per_opt_step": dispatches,
+                        "fused_kernels": fused_kernels,
                     },
                 )
             return out

@@ -94,8 +94,18 @@ def _mfu(cfg, n_params: int, seq: int, tokens_per_sec_chip: float) -> float:
 
 
 def _run(cfg, batch_size: int, seq: int, iters: int, warmup: int,
-         optimizer: str = "adamw", partial: Optional[PartialWriter] = None):
-    """Train-step throughput for one config -> (tokens/s/chip, step_s, n_params)."""
+         optimizer: str = "adamw", partial: Optional[PartialWriter] = None,
+         fused: bool = False):
+    """Train-step throughput for one config -> (tokens/s/chip, step_s, n_params).
+
+    ``fused=True`` is the step-speed-kernel pass of the dense A/B axis:
+    the same shapes with ``fused_kernels=True`` (Pallas prologue) and
+    ``fused_adamw`` (Pallas epilogue). On CPU the kernels run in
+    interpret mode — exact, slow — so the A/B number exists everywhere
+    but only means throughput on TPU.
+    """
+    import dataclasses
+
     import optax
 
     from accelerate_tpu import Accelerator
@@ -103,15 +113,23 @@ def _run(cfg, batch_size: int, seq: int, iters: int, warmup: int,
 
     partial = partial or _noop_writer("train")
     _reset_state()
+    if fused:
+        cfg = dataclasses.replace(cfg, fused_kernels=True)
     model = CausalLM(cfg)
     acc = Accelerator(mixed_precision="bf16")
     params = acc.prepare(
         model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))["params"]
     )
     n_params = count_params(params)
-    opt = acc.prepare(
-        optax.adamw(3e-4) if optimizer == "adamw" else optax.sgd(3e-4)
-    )
+    if fused and optimizer == "adamw":
+        from accelerate_tpu.ops.fused import fused_adamw
+
+        base_opt = fused_adamw(3e-4)
+    else:
+        base_opt = (
+            optax.adamw(3e-4) if optimizer == "adamw" else optax.sgd(3e-4)
+        )
+    opt = acc.prepare(base_opt)
     carry = acc.init_carry(params, opt)
     step = acc.unified_step(CausalLM.loss_fn(model), max_grad_norm=1.0)
 
@@ -1083,11 +1101,37 @@ def result_line(variant, partial: Optional[PartialWriter] = None) -> dict:
             },
         }
     else:
+        fused_ab = bool(variant.args[6]) if len(variant.args) > 6 else False
         tps, step_time, n_params = _run(
             cfg, batch_size, seq, iters, warmup, optimizer, partial=partial
         )
         mfu = _mfu(cfg, n_params, seq, tps)
         productive_s = step_time * iters
+        ab_extra: dict = {}
+        if fused_ab:
+            # second pass of the A/B axis: same shapes through the Pallas
+            # prologue + fused_adamw epilogue. The headline stays the
+            # faster of the two passes — on TPU that is the fused step,
+            # on CPU the interpret-mode kernels lose and the unfused
+            # number stands (the A/B delta is still the evidence).
+            f_tps, f_step, _ = _run(
+                cfg, batch_size, seq, iters, warmup, optimizer,
+                partial=None, fused=True,
+            )
+            f_mfu = _mfu(cfg, n_params, seq, f_tps)
+            productive_s += f_step * iters
+            ab_extra = {
+                "unfused": {"step_time_s": round(step_time, 4),
+                            "tokens_per_sec_per_chip": round(tps, 1),
+                            "mfu": round(mfu, 4)},
+                "fused": {"step_time_s": round(f_step, 4),
+                          "tokens_per_sec_per_chip": round(f_tps, 1),
+                          "mfu": round(f_mfu, 4)},
+                "fused_speedup": round(step_time / f_step, 3),
+                "headline_mode": "fused" if f_step <= step_time else "unfused",
+            }
+            if f_step <= step_time:
+                tps, step_time, mfu = f_tps, f_step, f_mfu
         rec = {
             "metric": f"train_tokens_per_sec_per_chip_{name}"
             if name != "dense" else "train_tokens_per_sec_per_chip",
@@ -1100,6 +1144,7 @@ def result_line(variant, partial: Optional[PartialWriter] = None) -> dict:
                 "params": n_params,
                 "device": _device_kind(),
                 "batch": batch_size, "seq": seq,
+                **ab_extra,
                 **probe(),
             },
         }

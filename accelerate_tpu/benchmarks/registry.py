@@ -122,10 +122,22 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
         # subset, not starve the tail on guesses
         tiny = TransformerConfig.tiny()
         return VariantRegistry([
-            _variant("dense", "train", 0, "dense", (tiny, 4, 128, 3, 1),
-                     fast=True, headline=True, default_estimate_s=15),
+            # accum registers FIRST inside the shared child: the round's
+            # first-run variant eats every cold persistent-cache compile
+            # (BENCH_r06: dense 61 misses / 10 hits vs 70-72 hits on every
+            # later variant — the headline was paying the whole round's
+            # cold-start bill as its own compile badput). dense keeps
+            # priority 0 + headline, so the group still schedules first
+            # and the consolidated block still leads with it; only the
+            # in-child run order moves the cold misses onto accum.
             _variant("accum", "accum", 1, "dense",
-                     (tiny, 4, 64, 6, 2), fast=True, default_estimate_s=10),
+                     (tiny, 4, 64, 6, 2), fast=True, default_estimate_s=12),
+            # trailing True = fused A/B axis: _run measures an unfused
+            # pass and a fused_kernels+fused_adamw pass in one variant
+            # (step_time_s for both in extra; the estimate covers both)
+            _variant("dense", "train", 0, "dense",
+                     (tiny, 4, 128, 3, 1, "adamw", True),
+                     fast=True, headline=True, default_estimate_s=30),
             _variant(
                 "moe", "train", 2, "moe",
                 (TransformerConfig.tiny(num_experts=4, num_experts_per_tok=2),
@@ -258,8 +270,11 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
         # late-session tunnel transient); the runner re-prints the
         # consolidated block with dense LAST for the parse-the-last-line
         # driver. accum shares the dense child: one spawn, one jax init.
-        _variant("dense", "train", 0, "dense", (dense, 8, 1024, 20, 3),
-                 fast=True, headline=True, default_estimate_s=600),
+        # trailing True = fused A/B axis (unfused + fused_kernels passes
+        # in one variant — the estimate covers both compiles + loops)
+        _variant("dense", "train", 0, "dense",
+                 (dense, 8, 1024, 20, 3, "adamw", True),
+                 fast=True, headline=True, default_estimate_s=900),
         _variant("accum", "accum", 1, "dense", (small, 4, 512, 8, 2),
                  fast=True, default_estimate_s=500),
         _variant("decode", "decode", 2, "decode", (decode, 1, 128, 64, 1),
@@ -311,6 +326,13 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
             (dataclasses.replace(longseq, attention_impl="xla"), 1, 8192, 4, 2),
             default_estimate_s=400, expected_oom=True,
         ),
+        # fp8 projections (e4m3 fwd / e5m2 bwd, ops/fp8.py) on the dense
+        # headline shape: tokens/s with the matmuls quantized vs the bf16
+        # dense line above. TPU-only (CPU has no fp8 MXU paths worth
+        # timing) and not in --fast.
+        _variant("fp8", "train", 6, "fp8",
+                 (dataclasses.replace(dense, fp8=True), 8, 1024, 20, 3),
+                 default_estimate_s=600),
         # checkpoint-open -> device-resident for the decode model; its own
         # group so a slow/failed load can never cost the decode headline.
         # decode_load moves ~11 GiB across the ~0.03 GiB/s axon tunnel —

@@ -17,7 +17,12 @@ levers:
 * :mod:`warmup` — ahead-of-time lower+compile a built step fn from
   ``ShapeDtypeStruct`` specs (derived from the prepared dataloader's
   fixed padded batch shape), so host data loading and XLA compilation
-  overlap instead of serialize.
+  overlap instead of serialize;
+* :mod:`overlap` — XLA async-collective + latency-hiding-scheduler
+  options for the ZeRO/FSDP paths (threaded through
+  ``CompilePlugin.compiler_options``, no-op on CPU) and the
+  profile-based collective/compute overlap report backing the
+  ``overlap_pct`` telemetry field.
 """
 
 from .cache import (
@@ -26,6 +31,14 @@ from .cache import (
     persistent_cache_entries,
 )
 from .monitor import CompileMonitor, get_compile_monitor
+from .overlap import (
+    DEFAULT_OVERLAP_OPTIONS,
+    assert_overlap,
+    collective_compute_overlap,
+    merge_compiler_options,
+    overlap_from_spans,
+    overlap_options,
+)
 from .warmup import batch_spec_of, spec_like, warm_step
 
 __all__ = [
@@ -34,6 +47,12 @@ __all__ = [
     "persistent_cache_entries",
     "CompileMonitor",
     "get_compile_monitor",
+    "DEFAULT_OVERLAP_OPTIONS",
+    "assert_overlap",
+    "collective_compute_overlap",
+    "merge_compiler_options",
+    "overlap_from_spans",
+    "overlap_options",
     "batch_spec_of",
     "spec_like",
     "warm_step",

@@ -44,6 +44,7 @@ class TraceCapture:
         self.config = config or DiagnosticsConfig()
         self.profile_kwargs = profile_kwargs or ProfileKwargs()
         self.captures: list[dict] = []  # one entry per started capture
+        self._finished: list[dict] = []  # stopped, not yet drained
         self._pending: Optional[str] = None  # reason of the queued capture
         self._active: Optional[dict] = None
         self._remaining = 0
@@ -161,8 +162,18 @@ class TraceCapture:
             jax.profiler.stop_trace()
         except Exception as exc:
             logger.warning(f"triggered trace capture failed to stop: {exc}")
+        else:
+            # the trace is on disk now — queue it for post-processing
+            # (the manager derives overlap_pct at the next step boundary)
+            self._finished.append(self._active)
         self._active = None
         self._remaining = 0
+
+    def pop_finished(self) -> Optional[dict]:
+        """Drain one completed (stopped-and-written) capture entry, oldest
+        first; None when nothing finished since the last call."""
+        with self._lock:
+            return self._finished.pop(0) if self._finished else None
 
     def close(self) -> None:
         """Stop any in-flight capture and restore the signal handler."""

@@ -44,6 +44,10 @@ class DiagnosticsManager:
         self.capture = TraceCapture(cfg)
         self.recorder = FlightRecorder(cfg, process_index=process_index)
         self._steps_seen = 0
+        # fields derived from a finished profile capture (overlap_pct);
+        # the collector drains them onto the NEXT step record — the step
+        # that triggered the stop has already been emitted by then
+        self._pending_step_fields: dict = {}
         if cfg.install_excepthook and cfg.dir is not None:
             self.recorder.install_excepthook()
         if cfg.sigusr1:
@@ -115,6 +119,25 @@ class DiagnosticsManager:
                 dir=started["dir"], reason=started["reason"],
                 start_step=started["start_step"],
             )
+        finished = self.capture.pop_finished()
+        if finished is not None:
+            # collective/compute overlap evidence from the fresh trace
+            # (best-effort: None on CPU / unparseable dumps)
+            from ..compilation.overlap import collective_compute_overlap
+
+            report = collective_compute_overlap(finished["dir"])
+            if report is not None:
+                self._pending_step_fields["overlap_pct"] = round(
+                    report["overlap_pct"], 2
+                )
+                self._pending_step_fields["overlap_capture_dir"] = (
+                    finished["dir"]
+                )
+                self.recorder.event(
+                    "overlap_report", dump=False,
+                    dir=finished["dir"],
+                    overlap_pct=report["overlap_pct"],
+                )
         if (
             self.goodput is not None
             and self.config.goodput_interval
@@ -122,6 +145,12 @@ class DiagnosticsManager:
         ):
             out.append(self.goodput.record(step=record.get("step")))
         return out
+
+    def pop_step_fields(self) -> dict:
+        """Fields the next step record should carry (capture-derived
+        ``overlap_pct``); drained once by the collector pre-emit."""
+        fields, self._pending_step_fields = self._pending_step_fields, {}
+        return fields
 
     def record_wait(self, seconds: float, source: str = "dataloader") -> None:
         """Live dataloader-wait attribution (called as each wait ends, so

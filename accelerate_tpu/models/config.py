@@ -143,6 +143,12 @@ class TransformerConfig:
     fp8: bool = False
     # remat: None | "full" | "dots" — trades FLOPs for HBM
     remat: Optional[str] = None
+    # fused Pallas step kernels (ops/fused.py): RMSNorm -> QKV -> rope in
+    # one kernel per attention block. Param tree and checkpoints are
+    # identical either way; numerics match the unfused chain to fp32
+    # tolerance (exact-shape fallback to the unfused path when a shape the
+    # kernel can't tile comes through, and interpret mode on CPU)
+    fused_kernels: bool = False
     # scan over layers: one compiled layer body, num_layers iterations —
     # keeps compile time flat in depth (essential at 8B+)
     scan_layers: bool = True

@@ -46,6 +46,11 @@ def count_params(params: Any) -> int:
 # ---------------------------------------------------------------------- #
 class RMSNorm(nn.Module):
     config: TransformerConfig
+    # param_only: declare and RETURN the scale without normalizing — the
+    # fused-prologue path (ops/fused.py) applies the norm inside its
+    # kernel and only needs the raw scale. Keeps the param at the same
+    # tree path either way, so checkpoints interchange with the flag off.
+    param_only: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -64,6 +69,8 @@ class RMSNorm(nn.Module):
             (x.shape[-1],),
             jnp.float32,
         )
+        if self.param_only:
+            return scale
         var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
         y = x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
         mult = (1.0 + scale) if cfg.norm_offset else scale
@@ -172,13 +179,50 @@ def _make_proj(cfg: TransformerConfig, dtype):
     return proj
 
 
+class _ProjParams(nn.Module):
+    """Declares exactly nn.Dense's param tree (kernel/bias names, shapes,
+    init fns, partitioning, param_dtype) WITHOUT running the matmul — the
+    fused prologue (ops/fused.py) consumes the raw arrays instead. Same
+    module name => same tree paths AND same per-param init RNG streams,
+    so checkpoints and init values interchange with the unfused path."""
+
+    features: int
+    axes: tuple
+    use_bias: bool = False
+    bias_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, in_features):
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(nn.initializers.lecun_normal(), self.axes),
+            (in_features, self.features),
+            jnp.float32,
+        )
+        if hasattr(kernel, "unbox"):
+            kernel = kernel.unbox()
+        bias = None
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_partitioning(
+                    nn.initializers.zeros_init(), (self.bias_axis,)
+                ),
+                (self.features,),
+                jnp.float32,
+            )
+            if hasattr(bias, "unbox"):
+                bias = bias.unbox()
+        return kernel, bias
+
+
 class Attention(nn.Module):
     config: TransformerConfig
     decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions, mask=None, kv_lengths=None,
-                 paged=None, layer_window=None):
+                 paged=None, layer_window=None, pre_norm_scale=None):
         decode = self.decode
         cfg = self.config
         # static homogeneous band, or the per-layer traced one (Gemma-2)
@@ -194,22 +238,64 @@ class Attention(nn.Module):
 
         proj = _make_proj(cfg, dtype)
 
-        q = proj(
-            "q_proj", q_dim, ("embed", "heads"),
-            use_bias=cfg.qkv_bias, bias_axis="heads",
-        )(x)
-        k = proj(
-            "k_proj", kv_dim, ("embed", "kv"),
-            use_bias=cfg.qkv_bias, bias_axis="kv",
-        )(x)
-        v = proj(
-            "v_proj", kv_dim, ("embed", "kv"),
-            use_bias=cfg.qkv_bias, bias_axis="kv",
-        )(x)
         b, s = x.shape[:2]
-        q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
-        k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
-        v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+        fused_qkv = False
+        if pre_norm_scale is not None:
+            # Block handed us the RAW residual stream + the norm scale:
+            # the fused-kernels path. Fuse norm -> qkv -> rope when the
+            # kernel supports the shape; otherwise apply the norm here
+            # (exact RMSNorm math) and fall through unfused.
+            from ..ops import fused as fused_ops
+
+            if (
+                not self.decode
+                and not cfg.fp8
+                and fused_ops.prologue_supported(
+                    cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                    b, s, x.shape[-1],
+                )
+            ):
+                wq, bq = _ProjParams(
+                    q_dim, ("embed", "heads"), cfg.qkv_bias, "heads",
+                    name="q_proj",
+                )(x.shape[-1])
+                wk, bk = _ProjParams(
+                    kv_dim, ("embed", "kv"), cfg.qkv_bias, "kv",
+                    name="k_proj",
+                )(x.shape[-1])
+                wv, bv = _ProjParams(
+                    kv_dim, ("embed", "kv"), cfg.qkv_bias, "kv",
+                    name="v_proj",
+                )(x.shape[-1])
+                q, k, v = fused_ops.fused_qkv_prologue(
+                    x, pre_norm_scale, wq, wk, wv, bq, bk, bv, positions,
+                    eps=cfg.rms_norm_eps, norm_offset=cfg.norm_offset,
+                    num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
+                    head_dim=cfg.head_dim, theta=cfg.rope_theta,
+                    scaling=cfg.rope_scaling, dtype=dtype,
+                )
+                fused_qkv = True
+            else:
+                x = fused_ops.rms_norm_reference(
+                    x, pre_norm_scale, eps=cfg.rms_norm_eps,
+                    norm_offset=cfg.norm_offset,
+                )
+        if not fused_qkv:
+            q = proj(
+                "q_proj", q_dim, ("embed", "heads"),
+                use_bias=cfg.qkv_bias, bias_axis="heads",
+            )(x)
+            k = proj(
+                "k_proj", kv_dim, ("embed", "kv"),
+                use_bias=cfg.qkv_bias, bias_axis="kv",
+            )(x)
+            v = proj(
+                "v_proj", kv_dim, ("embed", "kv"),
+                use_bias=cfg.qkv_bias, bias_axis="kv",
+            )(x)
+            q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+            k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+            v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
 
         use_paged = False
         if decode and paged is not None:
@@ -303,8 +389,9 @@ class Attention(nn.Module):
                 implementation="xla",
             )
         else:
-            q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-            k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+            if not fused_qkv:  # the fused prologue already applied rope
+                q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+                k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
             out = dot_product_attention(
                 q, k, v, mask=mask, causal=cfg.causal,
                 kv_lengths=kv_lengths,
@@ -503,10 +590,21 @@ class Block(nn.Module):
         from ..parallel.sharding import constrain_activations
 
         cfg = self.config
-        attn_out = Attention(cfg, decode=self.decode, name="attn")(
-            RMSNorm(cfg, name="attn_norm")(x), positions, mask, kv_lengths,
-            paged, layer_window,
-        )
+        if cfg.fused_kernels:
+            # fused prologue: hand Attention the raw residual stream plus
+            # the norm scale so ops/fused.py can run norm -> qkv -> rope
+            # as one kernel (it falls back to the exact unfused math for
+            # shapes it can't tile, and for decode/fp8)
+            attn_scale = RMSNorm(cfg, name="attn_norm", param_only=True)(x)
+            attn_out = Attention(cfg, decode=self.decode, name="attn")(
+                x, positions, mask, kv_lengths, paged, layer_window,
+                pre_norm_scale=attn_scale,
+            )
+        else:
+            attn_out = Attention(cfg, decode=self.decode, name="attn")(
+                RMSNorm(cfg, name="attn_norm")(x), positions, mask,
+                kv_lengths, paged, layer_window,
+            )
         if cfg.post_norms:
             # Gemma-2 block: a norm AFTER each sublayer too (pre + post,
             # 4 per block — transformers Gemma2DecoderLayer)
@@ -716,6 +814,9 @@ class CausalLM(nn.Module):
                 return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
             return jnp.mean(nll)
 
+        # telemetry: step records carry whether this step ran the fused
+        # prologue (unified_step reads the attribute off the closure)
+        fn.fused_kernels = bool(getattr(model.config, "fused_kernels", False))
         return fn
 
 

@@ -23,7 +23,7 @@ the TE integration's split of duties.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import flax.linen as nn
 import jax
@@ -91,6 +91,104 @@ def _fp8_matmul_bwd(res, g):
 
 
 fp8_matmul.defvjp(_fp8_matmul_fwd, _fp8_matmul_bwd)
+
+
+# --------------------------------------------------------------------- #
+# delayed scaling (TE DelayedScaling recipe): amax HISTORY in the train
+# state picks the scale, so quantization costs no extra amax reduction
+# on the critical path and the scale is stable across steps
+# --------------------------------------------------------------------- #
+class DelayedScaleState(NamedTuple):
+    """Per-tensor delayed-scaling state, carried in the train carry like
+    optimizer state (the reference threads this through TE's fp8_autocast
+    context; here it is an explicit pytree — jit/donate/checkpoint all
+    treat it like any other state leaf).
+
+    ``amax_history``: rolling window of observed amaxes, newest first.
+    ``scale``: the quantization scale used for the NEXT matmul, derived
+    from the history's max (TE's default ``amax_compute_algo="max"``).
+    """
+
+    amax_history: jax.Array  # (history_len,) f32
+    scale: jax.Array  # () f32, the s in quantize(x) = clip(x*s)
+
+
+def init_delayed_state(history_len: int = 16) -> DelayedScaleState:
+    """Fresh state: empty history, identity scale (first step quantizes
+    unscaled — the TE bootstrap behavior)."""
+    return DelayedScaleState(
+        amax_history=jnp.zeros((history_len,), jnp.float32),
+        scale=jnp.ones((), jnp.float32),
+    )
+
+
+def update_delayed_state(
+    state: DelayedScaleState, amax: jax.Array, fmax: float = E4M3_MAX
+) -> DelayedScaleState:
+    """Record one observed amax and recompute the scale from the rolled
+    history. A history of all zeros (nothing observed yet) keeps the
+    previous scale instead of dividing by zero."""
+    history = jnp.roll(state.amax_history, 1).at[0].set(
+        amax.astype(jnp.float32)
+    )
+    amax_r = jnp.max(history)
+    scale = jnp.where(amax_r > 0.0, fmax / jnp.maximum(amax_r, _EPS),
+                      state.scale)
+    return DelayedScaleState(amax_history=history, scale=scale)
+
+
+@jax.custom_vjp
+def _fp8_matmul_scaled(x, w, xs, ws):
+    out, _ = _fp8_matmul_scaled_fwd(x, w, xs, ws)
+    return out
+
+
+def _fp8_matmul_scaled_fwd(x, w, xs, ws):
+    xq = quantize_fp8(x, jnp.float8_e4m3fn, xs)
+    wq = quantize_fp8(w, jnp.float8_e4m3fn, ws)
+    out = jnp.einsum(
+        "...k,kn->...n",
+        xq.astype(jnp.bfloat16),
+        wq.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ) / (xs * ws)
+    return out, (xq, wq, xs, ws)
+
+
+def _fp8_matmul_scaled_bwd(res, g):
+    # gradients keep CURRENT scaling in e5m2 (range over mantissa): the
+    # delayed history covers the fwd tensors whose amax is step-stable;
+    # grad magnitude swings too fast for a 16-step window (TE ships the
+    # same split by default)
+    dx, dw = _fp8_matmul_bwd(res, g)
+    return dx, dw, jnp.zeros_like(res[2]), jnp.zeros_like(res[3])
+
+
+_fp8_matmul_scaled.defvjp(_fp8_matmul_scaled_fwd, _fp8_matmul_scaled_bwd)
+
+
+def fp8_matmul_delayed(
+    x: jax.Array,
+    w: jax.Array,
+    x_state: DelayedScaleState,
+    w_state: DelayedScaleState,
+) -> tuple[jax.Array, DelayedScaleState, DelayedScaleState]:
+    """``x @ w`` in fp8 with TE-style delayed scaling.
+
+    Quantizes with the scales the HISTORY chose (no amax reduction on
+    the forward critical path — the observed amaxes fold into the next
+    step's states, returned alongside the product). Once the history has
+    seen a tensor's range, the output matches :func:`fp8_matmul`'s
+    current-scaling result exactly for range-stable tensors.
+    """
+    out = _fp8_matmul_scaled(x, w, x_state.scale, w_state.scale)
+    amax_x = jnp.max(jnp.abs(jax.lax.stop_gradient(x).astype(jnp.float32)))
+    amax_w = jnp.max(jnp.abs(jax.lax.stop_gradient(w).astype(jnp.float32)))
+    return (
+        out,
+        update_delayed_state(x_state, amax_x),
+        update_delayed_state(w_state, amax_w),
+    )
 
 
 def convert_model(model: nn.Module) -> nn.Module:

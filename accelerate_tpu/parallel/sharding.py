@@ -288,6 +288,26 @@ def grad_buffer_shardings(
     return infer_opt_state_shardings(params, mesh, plugin)
 
 
+def wants_collective_overlap(
+    plugin: Optional[ParallelismPlugin], mesh: Optional[Mesh]
+) -> bool:
+    """Does this sharding layout issue per-step collectives worth hiding
+    under compute? True for the ZeRO/FSDP strategies (``SHARD_OPT`` /
+    ``SHARD_GRAD_OP`` / ``FULL_SHARD`` / ``HYBRID_SHARD``) on a mesh
+    whose data axes actually span devices — exactly the paths where the
+    step emits all-gather/reduce-scatter chains the latency-hiding
+    scheduler can reorder (``compilation.overlap`` consumes this to
+    decide whether to emit the XLA overlap options)."""
+    if plugin is None or mesh is None:
+        return False
+    if plugin.sharding_strategy == ShardingStrategy.NO_SHARD:
+        return False
+    return (
+        int(mesh.shape[MESH_AXIS_DATA]) * int(mesh.shape[MESH_AXIS_FSDP])
+        > 1
+    )
+
+
 def shard_params(
     params: Any,
     shardings: Any,

@@ -274,6 +274,12 @@ class StepTelemetry:
         if extra:
             for key, value in extra.items():
                 record.setdefault(key, value)
+        if self.diagnostics is not None:
+            # capture-derived fields (overlap_pct) land on the first step
+            # record AFTER the capture stopped — the trace needs to be on
+            # disk before it can be parsed
+            for key, value in self.diagnostics.pop_step_fields().items():
+                record.setdefault(key, value)
 
         tokens = None
         if batch is not None:

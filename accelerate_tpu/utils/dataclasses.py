@@ -364,6 +364,13 @@ class CompilePlugin(KwargsHandler):
     static_argnames: tuple[str, ...] = ()
     # XLA backend options, threaded into .lower().compile(...) by warmup
     compiler_options: Optional[dict[str, Any]] = None
+    # collective/compute overlap (compilation/overlap.py): None = auto
+    # (emit the async-collective + latency-hiding-scheduler options when
+    # the backend is TPU and the sharding layout issues per-step
+    # collectives), False = never, True = always-on-TPU regardless of
+    # sharding. Always a no-op on non-TPU backends. Explicit keys in
+    # ``compiler_options`` win over the emitted defaults.
+    overlap_collectives: Optional[bool] = None
     cache_dir: Optional[str] = None  # persistent compilation cache
     # Persistence floors: JAX defaults persist only compiles >1s / >4KiB —
     # tuned for giant programs. 0.0 / -1 persist everything (what a bench

@@ -242,9 +242,12 @@ def test_cpu_registry_groups_and_fast_subset():
     reg = build_registry(on_tpu=False)
     assert reg.headline == "dense"
     groups = reg.groups()
-    # dense group first (headline priority 0), dense before accum in it
+    # dense group first (headline priority 0); INSIDE the group accum
+    # runs first — the round's first variant eats every cold
+    # persistent-cache compile, and that must not be the headline
+    # (BENCH_r06: dense ate 61 misses while later variants saw hits)
     assert groups[0][0] == "dense"
-    assert [v.name for v in groups[0][1]] == ["dense", "accum"]
+    assert [v.name for v in groups[0][1]] == ["accum", "dense"]
     fast = reg.select(fast=True)
     assert set(fast.names) == {"dense", "accum", "overhead", "ckpt"}
     assert fast.headline == "dense"

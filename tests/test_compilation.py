@@ -345,3 +345,135 @@ def test_warmup_rejects_bare_callables():
     acc = _fresh_accelerator()
     with pytest.raises(TypeError, match="unified_step"):
         acc.warmup(lambda c, b: (c, {}), {}, {})
+
+
+# --------------------------------------------------------------------- #
+# collective/compute overlap (compilation/overlap.py)
+# --------------------------------------------------------------------- #
+def test_overlap_options_cpu_noop_tpu_default():
+    from accelerate_tpu.compilation.overlap import (
+        DEFAULT_OVERLAP_OPTIONS,
+        overlap_options,
+    )
+
+    # CPU backend would reject the TPU scheduler flags: must be empty
+    assert overlap_options(backend="cpu") == {}
+    opts = overlap_options(backend="tpu")
+    assert opts == DEFAULT_OVERLAP_OPTIONS
+    assert opts is not DEFAULT_OVERLAP_OPTIONS  # caller-owned copy
+
+
+def test_merge_compiler_options_user_wins():
+    from accelerate_tpu.compilation.overlap import merge_compiler_options
+
+    assert merge_compiler_options(None, None) is None
+    assert merge_compiler_options({}, None) is None
+    user = {"xla_enable_async_all_gather": False, "xla_custom": 1}
+    merged = merge_compiler_options(
+        {"xla_enable_async_all_gather": True, "xla_tpu_flag": True}, user
+    )
+    assert merged["xla_enable_async_all_gather"] is False  # user wins
+    assert merged["xla_tpu_flag"] is True
+    assert merged["xla_custom"] == 1
+    # no overlap flags -> user dict passes through untouched
+    assert merge_compiler_options(None, user) is user
+
+
+def test_wants_collective_overlap_gates_on_layout():
+    from accelerate_tpu.parallel.sharding import (
+        MESH_AXIS_DATA,
+        MESH_AXIS_FSDP,
+        ShardingStrategy,
+        wants_collective_overlap,
+    )
+
+    class _Mesh:
+        def __init__(self, data, fsdp):
+            self.shape = {MESH_AXIS_DATA: data, MESH_AXIS_FSDP: fsdp}
+
+    class _Plugin:
+        def __init__(self, strategy):
+            self.sharding_strategy = strategy
+
+    sharded = _Plugin(ShardingStrategy.FULL_SHARD)
+    assert wants_collective_overlap(None, _Mesh(2, 4)) is False
+    assert wants_collective_overlap(sharded, None) is False
+    assert (
+        wants_collective_overlap(_Plugin(ShardingStrategy.NO_SHARD), _Mesh(2, 4))
+        is False
+    )
+    # single-device mesh: nothing to hide
+    assert wants_collective_overlap(sharded, _Mesh(1, 1)) is False
+    assert wants_collective_overlap(sharded, _Mesh(2, 4)) is True
+    assert wants_collective_overlap(sharded, _Mesh(1, 8)) is True
+
+
+def test_overlap_from_spans_interval_math():
+    from accelerate_tpu.compilation.overlap import overlap_from_spans
+
+    # all-gather [0,10) with compute covering [0,6): 60% overlap; the
+    # async pair all-reduce-start [20,21) / -done [28,30) folds into one
+    # [20,30) interval, covered by compute [25,30): 5 of 10.
+    report = overlap_from_spans(
+        [
+            {"name": "fusion.1", "start": 0, "end": 6},
+            {"name": "all-gather.7", "start": 0, "end": 10},
+            {"name": "all-reduce.3-start", "start": 20, "end": 21},
+            {"name": "all-reduce.3-done", "start": 28, "end": 30},
+            {"name": "fusion.2", "start": 25, "end": 30},
+        ]
+    )
+    assert report["collective_time"] == 20
+    assert report["overlapped_time"] == 11
+    np.testing.assert_allclose(report["overlap_pct"], 55.0)
+    # no collectives -> nothing to measure
+    assert overlap_from_spans([{"name": "fusion", "start": 0, "end": 5}]) is None
+
+
+def test_xplane_wire_parser_round_trip():
+    from accelerate_tpu.compilation.overlap import (
+        parse_xspace_planes,
+        spans_from_plane,
+    )
+
+    def varint(n):
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def ld(field, payload):  # length-delimited field
+        return varint((field << 3) | 2) + varint(len(payload)) + payload
+
+    def vi(field, value):  # varint field
+        return varint(field << 3) + varint(value)
+
+    event = vi(1, 7) + vi(2, 100) + vi(3, 50)  # metadata_id/offset/duration
+    line = ld(2, b"xla-ops") + vi(3, 2) + ld(4, event)  # ts 2 ns
+    # map<int64, XEventMetadata> entry: key 7 -> {id: 7, name: ...}
+    entry = vi(1, 7) + ld(2, vi(1, 7) + ld(2, b"all-reduce.1"))
+    plane = ld(2, b"/device:TPU:0") + ld(3, line) + ld(4, entry)
+    space = ld(1, plane)
+
+    planes = parse_xspace_planes(space)
+    assert len(planes) == 1
+    assert planes[0]["name"] == "/device:TPU:0"
+    assert planes[0]["event_names"] == {7: "all-reduce.1"}
+    spans = spans_from_plane(planes[0])
+    # absolute ps timeline: 2 ns * 1000 + offset 100
+    assert spans == [{"name": "all-reduce.1", "start": 2100, "end": 2150}]
+
+
+def test_accelerator_cpu_overlap_is_noop(restore_cache_config):
+    """The Accelerator threads overlap options through compiler_options
+    at init; on CPU the option set is empty so the plugin sentinel stays
+    None — even when the user forces overlap_collectives=True."""
+    acc = _fresh_accelerator(
+        compile_plugin=CompilePlugin(overlap_collectives=True)
+    )
+    assert acc.compile_plugin.compiler_options is None
+    acc2 = _fresh_accelerator(compile_plugin=CompilePlugin())
+    assert acc2.compile_plugin.compiler_options is None

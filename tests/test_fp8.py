@@ -133,3 +133,86 @@ def test_int4_odd_reduction_dim_falls_back_to_int8():
     assert q.bits == 8  # graceful fallback, not a reshape crash
     rel = float(jnp.linalg.norm(q.dequantize() - w) / jnp.linalg.norm(w))
     assert rel < 0.01
+
+
+# --------------------------------------------------------------------- #
+# delayed scaling (TE DelayedScaling recipe)
+# --------------------------------------------------------------------- #
+def test_delayed_state_rolls_history_and_takes_max():
+    from accelerate_tpu.ops.fp8 import (
+        DelayedScaleState,
+        init_delayed_state,
+        update_delayed_state,
+    )
+
+    state = init_delayed_state(history_len=4)
+    assert state.amax_history.shape == (4,)
+    assert float(state.scale) == 1.0  # bootstrap: quantize unscaled
+    for amax in [0.1, 3.0, 0.5, 2.0]:
+        state = update_delayed_state(state, jnp.asarray(amax))
+    # newest-first rolling window, scale from the window max
+    np.testing.assert_allclose(
+        np.asarray(state.amax_history), [2.0, 0.5, 3.0, 0.1]
+    )
+    np.testing.assert_allclose(float(state.scale), E4M3_MAX / 3.0)
+    # the oldest observation falls out of the window
+    state = update_delayed_state(state, jnp.asarray(0.2))
+    np.testing.assert_allclose(
+        np.asarray(state.amax_history), [0.2, 2.0, 0.5, 3.0]
+    )
+    assert isinstance(state, DelayedScaleState)
+
+
+def test_delayed_state_zero_history_keeps_previous_scale():
+    from accelerate_tpu.ops.fp8 import DelayedScaleState, update_delayed_state
+
+    state = DelayedScaleState(
+        amax_history=jnp.zeros((4,), jnp.float32),
+        scale=jnp.asarray(7.5, jnp.float32),
+    )
+    state = update_delayed_state(state, jnp.asarray(0.0))
+    assert float(state.scale) == 7.5  # no div-by-zero, no scale jump
+
+
+def test_fp8_matmul_delayed_matches_current_scaling_when_warm():
+    """Once the history has seen the tensors' amaxes, the delayed path
+    must reproduce current scaling BITWISE (same scales -> same fp8
+    codes -> same einsum)."""
+    from accelerate_tpu.ops.fp8 import fp8_matmul_delayed, init_delayed_state
+
+    x = jax.random.normal(jax.random.PRNGKey(10), (16, 64))
+    w = jax.random.normal(jax.random.PRNGKey(11), (64, 32)) / 8.0
+    xs, ws = init_delayed_state(), init_delayed_state()
+    # warm-up step records the amaxes into the histories
+    _, xs, ws = fp8_matmul_delayed(x, w, xs, ws)
+    out, xs2, ws2 = fp8_matmul_delayed(x, w, xs, ws)
+    ref = fp8_matmul(x, w)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # range-stable tensors keep the scale fixed
+    np.testing.assert_array_equal(float(xs2.scale), float(xs.scale))
+    np.testing.assert_array_equal(float(ws2.scale), float(ws.scale))
+
+
+def test_fp8_matmul_delayed_grads_match_current_scaling():
+    """Backward keeps current scaling for grads (TE default): with warm
+    histories the delayed vjp must equal fp8_matmul's bitwise, and the
+    scale-state inputs must get zero cotangents."""
+    from accelerate_tpu.ops.fp8 import fp8_matmul_delayed, init_delayed_state
+
+    x = jax.random.normal(jax.random.PRNGKey(12), (8, 32))
+    w = jax.random.normal(jax.random.PRNGKey(13), (32, 16)) / 6.0
+    t = jax.random.normal(jax.random.PRNGKey(14), (8, 16))
+    xs, ws = init_delayed_state(), init_delayed_state()
+    _, xs, ws = fp8_matmul_delayed(x, w, xs, ws)
+
+    def loss_delayed(x, w):
+        out, _, _ = fp8_matmul_delayed(x, w, xs, ws)
+        return jnp.mean((out - t) ** 2)
+
+    def loss_current(x, w):
+        return jnp.mean((fp8_matmul(x, w) - t) ** 2)
+
+    gd = jax.grad(loss_delayed, argnums=(0, 1))(x, w)
+    gc = jax.grad(loss_current, argnums=(0, 1))(x, w)
+    for a, b in zip(gd, gc):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
