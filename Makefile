@@ -31,11 +31,12 @@ FAST_FILES = \
   tests/test_checkpoint_async.py tests/test_fused_accum.py \
   tests/test_diagnostics.py tests/test_benchmarks.py \
   tests/test_serving.py tests/test_serving_obs.py \
-  tests/test_elastic.py tests/test_fused_kernels.py
+  tests/test_elastic.py tests/test_fused_kernels.py \
+  tests/test_slice_mesh.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
   diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke \
-  kernels-smoke
+  slice-smoke kernels-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -118,6 +119,15 @@ serve-obs-smoke:
 elastic-smoke:
 	JAX_PLATFORMS=cpu $(PYTEST) -q \
 	  tests/test_elastic.py::test_elastic_kill_and_reform
+
+# slice-level acceptance (<60s CPU): a 2-slice x 2-proc simulated fleet
+# loses ALL of slice 1 to an injected `kill@7:slice=1` mid-run; the
+# supervisor must drop the whole slice in ONE generation, re-form the
+# survivors as a 1-slice world, and finish bitwise-identical to a clean
+# 1-slice run resumed from the same committed checkpoint
+slice-smoke:
+	JAX_PLATFORMS=cpu $(PYTEST) -q \
+	  tests/test_elastic.py::test_slice_kill_and_reform
 
 # step-speed kernel acceptance on CPU (<120s): interpret-mode Pallas
 # prologue matches the reference chain (values + grads), the fused adamw

@@ -1114,17 +1114,32 @@ class Accelerator:
 
     def _zero2_grad_shardings(self, params: Any):
         """Shardings for the accumulated-grad carry buffer under ZeRO-2
-        (SHARD_GRAD_OP), else None (buffer follows the params)."""
+        (SHARD_GRAD_OP), else None (buffer follows the params).
+
+        Also engaged on hierarchical (multi-slice) meshes for the
+        strategies whose params stay replicated over fsdp (NO_SHARD /
+        SHARD_OPT / SHARD_GRAD_OP): pinning the grad buffer to its fsdp
+        shards makes GSPMD lower the cross-replica grad reduction as
+        reduce-scatter-in-slice (ICI) -> all-reduce-over-dp (DCN) ->
+        all-gather-in-slice, so the slow DCN hop moves 1/fsdp_size of
+        the bytes. FULL_SHARD/HYBRID_SHARD grads already follow the
+        fsdp-sharded params and get the hierarchical lowering for free.
+        """
+        from .parallel.mesh import mesh_num_slices
         from .parallel.sharding import grad_buffer_shardings
         from .utils.dataclasses import ShardingStrategy
 
         plugin = self.state.parallelism_plugin
-        if (
-            plugin.sharding_strategy is not ShardingStrategy.SHARD_GRAD_OP
-            or self.mesh.shape.get("fsdp", 1) <= 1
-        ):
+        if self.mesh.shape.get("fsdp", 1) <= 1:
             return None
-        return grad_buffer_shardings(params, self.mesh, plugin)
+        if plugin.sharding_strategy is ShardingStrategy.SHARD_GRAD_OP:
+            return grad_buffer_shardings(params, self.mesh, plugin)
+        if plugin.sharding_strategy not in (
+            ShardingStrategy.FULL_SHARD,
+            ShardingStrategy.HYBRID_SHARD,
+        ) and mesh_num_slices(self.mesh) > 1:
+            return grad_buffer_shardings(params, self.mesh, plugin)
+        return None
 
     def sync_from_carry(self, carry: dict) -> None:
         """Force host mirrors (``step``, ``sync_gradients``) to the carry's

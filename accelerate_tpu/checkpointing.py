@@ -334,21 +334,33 @@ def topology_metadata(accelerator) -> dict[str, Any]:
     """The save-time topology record stamped into the commit protocol
     (``topology.json``): everything a restore on a DIFFERENT fleet needs
     to validate the checkpoint and to explain a mismatch — world size,
-    device count, mesh shape, and the process -> shard-file map."""
+    device count, mesh shape, and the process -> shard-file map.
+
+    format_version 2 adds the slice layout: top-level ``num_slices`` and
+    a per-process ``fault_domain`` (slice id, slice-major contiguous
+    rank numbering). Purely additive — every reader uses ``.get``, so v1
+    checkpoints load unchanged and v1 readers ignore the new fields.
+    """
     from .dist_checkpoint import INDEX_FILE_PATTERN, SHARD_FILE_PATTERN
+    from .parallel.mesh import fault_domain_of_rank, mesh_num_slices
 
     world = accelerator.num_processes
     num_devices = int(accelerator.state.num_devices)
+    num_slices = mesh_num_slices(accelerator.state.mesh)
+    if world % max(1, num_slices) != 0:
+        num_slices = 1  # inconsistent env: don't stamp an unusable layout
     return {
-        "format_version": 1,
+        "format_version": 2,
         "world_size": world,
         "num_devices": num_devices,
         "devices_per_process": num_devices // max(1, world),
+        "num_slices": num_slices,
         "mesh_shape": {k: int(v) for k, v in accelerator.state.mesh.shape.items()},
         "process_shard_files": {
             str(p): {
                 "shard": SHARD_FILE_PATTERN.format(p),
                 "index": INDEX_FILE_PATTERN.format(p),
+                "fault_domain": fault_domain_of_rank(p, world, num_slices),
             }
             for p in range(world)
         },

@@ -73,6 +73,10 @@ class GenerationRecord:
     dead_ranks: list[int]
     exit_codes: dict[int, Optional[int]]
     duration_s: float
+    # hierarchical (multi-slice) runs: how many slices this generation
+    # ran with, and which fault domains (slice ids) it lost
+    num_slices: int = 1
+    dead_domains: list[int] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -93,6 +97,17 @@ class ElasticSupervisor:
     spawn (tests use it to snapshot checkpoints between generations).
     ``cpu=True`` pins children to the CPU backend (the local debug
     topology); pass False when the child env already selects a platform.
+
+    ``num_slices > 1`` turns on slice fault domains: ranks are assigned
+    slice-major (ranks ``[s*P, (s+1)*P)`` form slice ``s``,
+    ``P = num_processes / num_slices``), every rank's env carries its
+    ``ACCELERATE_TPU_FAULT_DOMAIN`` + the generation's
+    ``ACCELERATE_TPU_NUM_SLICES``, and a death declaration expands to
+    the victim's WHOLE slice — the unit of failure on a DCN-linked pod
+    is a slice, and re-forming at ``world - 1`` would land on a
+    topology no hierarchical mesh can use. Survivors relaunch as a
+    valid ``(num_slices - len(dead_domains))``-slice fleet in ONE
+    generation.
     """
 
     def __init__(
@@ -109,6 +124,7 @@ class ElasticSupervisor:
         env: Optional[dict[str, str]] = None,
         cpu: bool = True,
         generation_hook: Optional[Callable[[int, int], None]] = None,
+        num_slices: int = 1,
     ):
         if num_processes < 1:
             raise ValueError("num_processes must be >= 1")
@@ -117,9 +133,19 @@ class ElasticSupervisor:
                 f"min_processes must be in [1, num_processes]; got "
                 f"{min_processes} with num_processes={num_processes}"
             )
+        if num_slices < 1:
+            raise ValueError("num_slices must be >= 1")
+        if num_processes % num_slices != 0:
+            raise ValueError(
+                f"num_processes={num_processes} must be divisible by "
+                f"num_slices={num_slices} (slice-major contiguous rank "
+                "assignment needs equal-sized fault domains)"
+            )
         self.cmd = list(cmd)
         self.num_processes = num_processes
         self.min_processes = min_processes
+        self.num_slices = num_slices
+        self.procs_per_slice = num_processes // num_slices
         self.heartbeat_dir = heartbeat_dir
         self.stall_timeout_s = stall_timeout_s
         self.grace_period_s = grace_period_s
@@ -134,12 +160,42 @@ class ElasticSupervisor:
             os.makedirs(heartbeat_dir, exist_ok=True)
 
     # ------------------------------------------------------------------ #
+    def _fault_domain(self, rank: int) -> int:
+        """Slice id of a rank (slice-major contiguous assignment). The
+        slice width is fixed for the run — whole slices die, so every
+        generation's world is a multiple of ``procs_per_slice``."""
+        if self.num_slices <= 1:
+            return 0
+        return rank // self.procs_per_slice
+
+    def _world_slices(self, world: int) -> int:
+        """How many slices a generation's world spans."""
+        if self.num_slices <= 1:
+            return 1
+        return max(1, world // self.procs_per_slice)
+
+    def _expand_to_domains(
+        self, dead: set[int], world: int
+    ) -> tuple[set[int], list[int]]:
+        """Expand a dead-rank set to every rank in the affected fault
+        domains -> (expanded set, sorted dead domain ids). Identity when
+        the run is single-slice."""
+        if self.num_slices <= 1 or not dead:
+            return set(dead), []
+        domains = sorted({self._fault_domain(r) for r in dead})
+        expanded = {
+            r for r in range(world) if self._fault_domain(r) in domains
+        }
+        return expanded, domains
+
     def _child_env(self, rank: int, world: int, generation: int, port: int):
         env = {**os.environ, **self.env}
         if self.cpu:
             env["JAX_PLATFORMS"] = "cpu"
         env[ENV_PREFIX + "NUM_PROCESSES"] = str(world)
         env[ENV_PREFIX + "PROCESS_ID"] = str(rank)
+        env[ENV_PREFIX + "NUM_SLICES"] = str(self._world_slices(world))
+        env[ENV_PREFIX + "FAULT_DOMAIN"] = str(self._fault_domain(rank))
         env[ENV_PREFIX + "COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env[ENV_PREFIX + "ELASTIC"] = "1"
         env[ENV_PREFIX + "ELASTIC_GENERATION"] = str(generation)
@@ -186,16 +242,23 @@ class ElasticSupervisor:
             record = self._run_generation(generation, world)
             self.history.append(record)
             if record.outcome == "success":
-                self._event("run_complete", generations=generation + 1)
+                self._event(
+                    "run_complete",
+                    generation=generation,
+                    generations=generation + 1,
+                )
                 return 0
             survivors = world - len(record.dead_ranks)
             if survivors < self.min_processes:
                 record.outcome = "below_min"
                 self._event(
                     "giving_up",
+                    generation=generation,
                     survivors=survivors,
                     min_processes=self.min_processes,
                     dead_ranks=record.dead_ranks,
+                    victim_ranks=record.dead_ranks,
+                    fault_domains=record.dead_domains,
                 )
                 logger.error(
                     f"elastic: {survivors} survivor(s) after generation "
@@ -209,6 +272,10 @@ class ElasticSupervisor:
                 old_world=world,
                 new_world=survivors,
                 dead_ranks=record.dead_ranks,
+                victim_ranks=record.dead_ranks,
+                fault_domains=record.dead_domains,
+                old_num_slices=record.num_slices,
+                new_num_slices=self._world_slices(survivors),
             )
             world = survivors
         logger.error(
@@ -221,7 +288,14 @@ class ElasticSupervisor:
     def _run_generation(self, generation: int, world: int) -> GenerationRecord:
         t0 = time.monotonic()
         port = _free_port()
-        self._event("generation_start", generation=generation, world=world, port=port)
+        num_slices = self._world_slices(world)
+        self._event(
+            "generation_start",
+            generation=generation,
+            world=world,
+            port=port,
+            num_slices=num_slices,
+        )
         procs: dict[int, subprocess.Popen] = {}
         logs = []
         for rank in range(world):
@@ -269,37 +343,69 @@ class ElasticSupervisor:
                     # (oldest last beat: the straggler); the rest are
                     # survivors and re-form. A hung rank gets SIGKILL, not
                     # SIGTERM: it is wedged, the final-checkpoint contract
-                    # cannot run anyway.
+                    # cannot run anyway. On a multi-slice run, every stale
+                    # rank sharing the straggler's fault domain is declared
+                    # with it — a slice-level fault (power, DCN link) wedges
+                    # the whole slice at once, and burning one generation
+                    # per rank would re-form num_slices*P times.
                     victim = min(
                         stale, key=lambda r: stale[r].get("time_unix", 0.0)
                     )
+                    victims = [victim]
+                    if self.num_slices > 1:
+                        domain = self._fault_domain(victim)
+                        victims = sorted(
+                            r
+                            for r in stale
+                            if self._fault_domain(r) == domain
+                        )
                     self._event(
                         "heartbeat_death",
                         generation=generation,
                         rank=victim,
+                        victim_ranks=victims,
+                        fault_domain=self._fault_domain(victim),
+                        fault_domains=[self._fault_domain(victim)],
                         last_step=stale[victim].get("step"),
                         age_s=stale[victim].get("age_s"),
                     )
-                    # SIGABRT first: with PYTHONFAULTHANDLER the victim's
+                    # SIGABRT first: with PYTHONFAULTHANDLER each victim's
                     # wedged stack prints to its log before it dies
-                    self._kill(running[victim], signal.SIGABRT)
-                    try:
-                        running[victim].wait(timeout=3)
-                    except subprocess.TimeoutExpired:
-                        self._kill(running[victim], signal.SIGKILL)
-                        running[victim].wait()
-                    dead.add(victim)
+                    for v in victims:
+                        self._kill(running[v], signal.SIGABRT)
+                    for v in victims:
+                        try:
+                            running[v].wait(timeout=3)
+                        except subprocess.TimeoutExpired:
+                            self._kill(running[v], signal.SIGKILL)
+                            running[v].wait()
+                    dead.update(victims)
             if dead:
+                victims = sorted(dead)
+                dead, dead_domains = self._expand_to_domains(dead, world)
+                if set(victims) != dead:
+                    # whole-slice drop: the survivors of the victim's
+                    # slice are healthy processes on a dead fault domain
+                    self._event(
+                        "slice_death",
+                        generation=generation,
+                        fault_domains=dead_domains,
+                        victim_ranks=victims,
+                        dropped_ranks=sorted(dead),
+                    )
                 self._event(
                     "rank_death",
                     generation=generation,
                     dead_ranks=sorted(dead),
+                    victim_ranks=victims,
+                    fault_domains=dead_domains,
                     exit_codes={
                         r: procs[r].returncode for r in sorted(dead)
                     },
                 )
                 self._teardown(
-                    {r: p for r, p in procs.items() if p.poll() is None}
+                    {r: p for r, p in procs.items() if p.poll() is None},
+                    generation=generation,
                 )
                 break
             if not running:
@@ -310,6 +416,7 @@ class ElasticSupervisor:
                     dead_ranks=[],
                     exit_codes={r: p.returncode for r, p in procs.items()},
                     duration_s=time.monotonic() - t0,
+                    num_slices=num_slices,
                 )
             if deadline is not None and time.monotonic() > deadline:
                 self._event(
@@ -326,6 +433,9 @@ class ElasticSupervisor:
                 dead = set(running)
                 break
             time.sleep(self.monitor_interval_s)
+        # idempotent on the rank_death path, and folds the timeout path's
+        # kill-everyone set onto whole fault domains too
+        dead, dead_domains = self._expand_to_domains(dead, world)
         return GenerationRecord(
             generation=generation,
             world=world,
@@ -333,6 +443,8 @@ class ElasticSupervisor:
             dead_ranks=sorted(dead),
             exit_codes={r: p.returncode for r, p in procs.items()},
             duration_s=time.monotonic() - t0,
+            num_slices=num_slices,
+            dead_domains=dead_domains,
         )
 
     # ------------------------------------------------------------------ #
@@ -342,7 +454,11 @@ class ElasticSupervisor:
         except (ProcessLookupError, OSError):
             pass
 
-    def _teardown(self, survivors: dict[int, subprocess.Popen]) -> None:
+    def _teardown(
+        self,
+        survivors: dict[int, subprocess.Popen],
+        generation: int = -1,
+    ) -> None:
         """SIGTERM -> grace -> SIGKILL. The SIGTERM gives each survivor's
         CheckpointManager its final-checkpoint attempt; a survivor stuck
         in a collective against the dead rank never reaches the handler's
@@ -368,7 +484,9 @@ class ElasticSupervisor:
             except subprocess.TimeoutExpired:
                 pass
         if killed:
-            self._event("teardown_sigkill", ranks=sorted(killed))
+            self._event(
+                "teardown_sigkill", generation=generation, ranks=sorted(killed)
+            )
 
 
 def elastic_launcher_command(args, cfg) -> int:
@@ -390,5 +508,6 @@ def elastic_launcher_command(args, cfg) -> int:
         grace_period_s=args.grace_period,
         max_generations=args.max_restarts + 1 if args.max_restarts else 8,
         env=cfg.to_env(),
+        num_slices=getattr(args, "num_slices", 1) or 1,
     )
     return supervisor.run()

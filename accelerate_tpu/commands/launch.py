@@ -96,6 +96,14 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         "files (enables heartbeat-based death detection; "
                         "exported to ranks as "
                         "ACCELERATE_TPU_ELASTIC_HEARTBEAT_DIR)")
+    parser.add_argument("--num_slices", type=int, default=1,
+                        help="Elastic: slice fault domains. Ranks are "
+                        "assigned slice-major (N/num_slices per slice); a "
+                        "death drops the victim's WHOLE slice in one "
+                        "generation and survivors re-form as a "
+                        "(num_slices-1)-slice hierarchical mesh. Each rank "
+                        "sees ACCELERATE_TPU_NUM_SLICES + "
+                        "ACCELERATE_TPU_FAULT_DOMAIN.")
     parser.add_argument("--gcloud", action="store_true",
                         help="Fan out to all pod workers via gcloud ssh")
     parser.add_argument("--tpu_name", default=None)

@@ -48,6 +48,16 @@ DEFAULT_OVERLAP_OPTIONS: dict[str, Any] = {
     "xla_tpu_data_parallel_opt_different_sized_ops": True,
 }
 
+# Added on hierarchical (multi-slice) meshes: DCN-crossing collectives
+# are orders of magnitude slower than ICI ones, so the scheduler must
+# rank them FIRST — issue the cross-slice all-reduce as early as its
+# operands exist and hide the long DCN latency under the in-slice
+# compute + ICI collectives that follow.
+DCN_OVERLAP_OPTIONS: dict[str, Any] = {
+    "xla_tpu_enable_async_collective_fusion_fuse_all_reduce": True,
+    "xla_tpu_dcn_max_overlap_estimation": 32,
+}
+
 _COLLECTIVE_RE = re.compile(
     r"all[-_]gather|all[-_]reduce|reduce[-_]scatter|all[-_]to[-_]all"
     r"|collective[-_]permute|ragged[-_]all[-_]to[-_]all",
@@ -86,12 +96,16 @@ def overlap_options(
             return {}
     if backend != "tpu":
         return {}
+    options = dict(DEFAULT_OVERLAP_OPTIONS)
     if plugin is not None and mesh is not None:
+        from ..parallel.mesh import mesh_num_slices
         from ..parallel.sharding import wants_collective_overlap
 
         if not wants_collective_overlap(plugin, mesh):
             return {}
-    return dict(DEFAULT_OVERLAP_OPTIONS)
+        if mesh_num_slices(mesh) > 1:
+            options.update(DCN_OVERLAP_OPTIONS)
+    return options
 
 
 def merge_compiler_options(

@@ -64,6 +64,7 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
                 heartbeat_age_s=hb.get("age_s"),
                 stale=hb.get("stale"),
                 stalled_self=hb.get("stalled"),
+                fault_domain=hb.get("fault_domain"),
             )
             if info.get("last_step") is None:
                 info["last_step"] = hb.get("step")
@@ -108,10 +109,25 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
             for r, info in ranks.items()
             if info.get("heartbeat_age_s") is not None and not info.get("stale")
         )
+        # hierarchical topologies: group ranks by the fault_domain their
+        # heartbeats carry — a slice whose EVERY heartbeat-bearing rank is
+        # stale is a lost slice, and the relaunch verdict names it
+        domains: dict[int, list[dict[str, Any]]] = {}
+        for info in ranks.values():
+            fd = info.get("fault_domain")
+            if fd is not None:
+                domains.setdefault(int(fd), []).append(info)
+        lost_slices = sorted(
+            d
+            for d, members in domains.items()
+            if all(m.get("stale") for m in members)
+        )
         elastic = {
             "survivors": survivors,
             "num_survivors": len(survivors),
             "num_ranks": len(ranks),
+            "num_slices": len(domains) if domains else None,
+            "lost_slices": lost_slices,
             "saved_topology": None,
             "needs_reshape": None,
             "restartable": None,
@@ -128,8 +144,13 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
                 elastic["saved_topology"] = {
                     "world_size": topo.get("world_size"),
                     "num_devices": topo.get("num_devices"),
+                    "num_slices": topo.get("num_slices"),
                     "step": topo.get("step"),
                 }
+                if topo.get("num_slices"):
+                    # save-time slice count is authoritative (heartbeats
+                    # only cover ranks that ever beat)
+                    elastic["num_slices"] = int(topo["num_slices"])
                 elastic["needs_reshape"] = (
                     topo.get("world_size") != len(survivors)
                 )
@@ -264,7 +285,23 @@ def format_report(report: dict) -> str:
     elastic = report.get("elastic")
     if elastic is not None:
         m, n = elastic["num_survivors"], elastic["num_ranks"]
-        if elastic["restartable"]:
+        lost = elastic.get("lost_slices") or []
+        num_slices = elastic.get("num_slices") or 1
+        if elastic["restartable"] and lost and num_slices > 1:
+            # hierarchical topology: the unit of failure is a slice, and
+            # the verdict names which one(s) the survivors re-form without
+            noun = "slices" if len(lost) > 1 else "slice"
+            ids = ",".join(str(s) for s in lost)
+            line = (
+                f"Elastic: {noun} {ids} of {num_slices} lost; RESTARTABLE "
+                f"as {max(num_slices - len(lost), 1)}-slice reshaped restore"
+            )
+            topo = elastic.get("saved_topology")
+            if topo is not None:
+                line += f" from step {topo.get('step')}"
+            line += f" ({m} survivor(s) of {n})"
+            lines.append(line)
+        elif elastic["restartable"]:
             line = f"Elastic: RESTARTABLE with {m} survivor(s) of {n}"
             topo = elastic.get("saved_topology")
             if topo is not None:

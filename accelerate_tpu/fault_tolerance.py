@@ -171,6 +171,24 @@ class CheckpointManager:
                     f"checkpoint {ck} is unusable ({exc!r}); "
                     "falling back to the next-newest committed checkpoint"
                 )
+                # name the skip in the flight recorder AT SKIP TIME: when
+                # the fallback eventually succeeds nothing else records
+                # that a committed checkpoint was silently passed over
+                diagnostics = getattr(
+                    getattr(self.accelerator, "telemetry", None),
+                    "diagnostics",
+                    None,
+                )
+                recorder = getattr(diagnostics, "recorder", None)
+                if recorder is not None:
+                    try:
+                        recorder.event(
+                            "checkpoint_skipped",
+                            checkpoint=ck,
+                            error=repr(exc),
+                        )
+                    except Exception:
+                        pass  # observability must not break the fallback
                 last_exc = exc
                 continue
             logger.info(f"resumed from step {self.accelerator.step} ({ck})")

@@ -10,14 +10,67 @@ every strategy, and GSPMD lowers shardings over it to ICI/DCN collectives.
 from __future__ import annotations
 
 import math
+import os
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from ..utils.constants import MESH_AXES
+from ..utils.constants import ENV_PREFIX, MESH_AXES
 from ..utils.dataclasses import ParallelismPlugin
+
+NUM_SLICES_ENV = f"{ENV_PREFIX}NUM_SLICES"
+FAULT_DOMAIN_ENV = f"{ENV_PREFIX}FAULT_DOMAIN"
+
+
+def resolve_num_slices(devices: Optional[Sequence[jax.Device]] = None) -> int:
+    """How many ICI-connected slices the fleet spans.
+
+    Resolution order: explicit ``ACCELERATE_TPU_NUM_SLICES`` env (the
+    elastic supervisor exports it, and CPU simulations have no hardware
+    attribute to read), then the TPU ``slice_index`` device attribute,
+    else 1 (single-slice: every collective stays on ICI).
+    """
+    env = os.environ.get(NUM_SLICES_ENV)
+    if env:
+        n = int(env)
+        if n < 1:
+            raise ValueError(f"{NUM_SLICES_ENV}={env} must be >= 1")
+        return n
+    if devices is None:
+        devices = jax.devices()
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    slice_ids.discard(None)
+    return max(len(slice_ids), 1)
+
+
+def mesh_num_slices(mesh: Mesh) -> int:
+    """Number of slices a built mesh spans (env override, then device
+    attributes). 1 means no DCN hop exists and hierarchical reduction
+    degenerates to the flat path. Tolerates mesh-shaped stand-ins
+    without ``.devices`` (tests) by falling back to the process-global
+    slice count."""
+    devices = getattr(mesh, "devices", None)
+    return resolve_num_slices(
+        list(devices.flat) if devices is not None else None
+    )
+
+
+def fault_domain_of_rank(rank: int, world: int, num_slices: int) -> int:
+    """Slice id (fault domain) of a process rank under the slice-major
+    contiguous numbering this package uses everywhere: ranks
+    ``[s*world/num_slices, (s+1)*world/num_slices)`` live on slice ``s``.
+
+    Pure python — the elastic supervisor calls this without importing jax.
+    """
+    if num_slices <= 1:
+        return 0
+    if world % num_slices != 0:
+        raise ValueError(
+            f"world size {world} is not divisible by num_slices {num_slices}"
+        )
+    return rank // (world // num_slices)
 
 
 def resolve_mesh_shape(
@@ -62,6 +115,25 @@ def build_mesh(
 
         # validate on RESOLVED degrees so pp_size=-1 can't skip the check
         validate_pipeline_plugin(plugin, resolved_shape=shape)
+    num_slices = resolve_num_slices(devices)
+    if num_slices > 1:
+        # Slice-major device order: the outermost (slowest-varying) mesh
+        # axes tile whole slices, so every fsdp/ep/sp/tp group lives inside
+        # one slice and only dp (and pp stage boundaries) cross DCN. On TPU
+        # the slice_index attribute orders devices; on the CPU simulation
+        # device ids already follow the supervisor's contiguous slice-major
+        # rank assignment.
+        devices = sorted(
+            devices, key=lambda d: (getattr(d, "slice_index", 0) or 0, d.id)
+        )
+        outer = shape["dp"] * shape["pp"]
+        if outer % num_slices != 0:
+            raise ValueError(
+                f"hierarchical mesh needs the DCN-crossing axes (dp x pp = {outer}) "
+                f"to tile the {num_slices} slices; got mesh degrees {shape}. "
+                "Size dp (or pp) as a multiple of the slice count so "
+                "fsdp/ep/sp/tp groups never straddle a slice boundary."
+            )
     dims = tuple(shape[a] for a in MESH_AXES)
     device_array = np.asarray(devices).reshape(dims)
     return Mesh(device_array, MESH_AXES)

@@ -37,7 +37,7 @@ from ..utils.constants import (
     MESH_AXIS_TENSOR,
 )
 from ..utils.dataclasses import ParallelismPlugin, ShardingStrategy
-from .mesh import data_axes
+from .mesh import data_axes, mesh_num_slices
 
 # Default logical-axis -> mesh-axis rules, in priority order. Models using
 # flax logical axis names (t5x/maxtext convention) get TP/SP for free.
@@ -288,6 +288,42 @@ def grad_buffer_shardings(
     return infer_opt_state_shardings(params, mesh, plugin)
 
 
+def hierarchical_psum(
+    x: Any,
+    *,
+    cross_slice_axis: str = MESH_AXIS_DATA,
+    in_slice_axis: str = MESH_AXIS_FSDP,
+    axis_sizes: Optional[dict[str, int]] = None,
+):
+    """Gradient all-reduce restructured for a hierarchical (multi-slice)
+    mesh, usable inside ``shard_map``:
+
+        reduce-scatter in-slice (ICI) -> all-reduce cross-slice (DCN)
+        -> all-gather in-slice (ICI)
+
+    Mathematically ``psum(x, (cross_slice_axis, in_slice_axis))``, but the
+    slow DCN hop moves ``1/in_slice_size`` of the bytes: each in-slice
+    group first reduce-scatters over fast ICI, only the scattered shard
+    crosses DCN, and the result is re-gathered inside each slice.
+
+    Falls back to the flat psum when the leading dim does not tile the
+    in-slice axis (scalars, odd remainders) — correctness first, the
+    byte savings only apply to the tileable majority.
+    """
+    if axis_sizes is not None:
+        in_size = axis_sizes.get(in_slice_axis, 1)
+    else:
+        in_size = jax.lax.psum(1, in_slice_axis)
+    shape = tuple(getattr(x, "shape", ()))
+    if not shape or (isinstance(in_size, int) and shape[0] % in_size != 0):
+        return jax.lax.psum(x, (cross_slice_axis, in_slice_axis))
+    shard = jax.lax.psum_scatter(
+        x, in_slice_axis, scatter_dimension=0, tiled=True
+    )
+    shard = jax.lax.psum(shard, cross_slice_axis)
+    return jax.lax.all_gather(shard, in_slice_axis, axis=0, tiled=True)
+
+
 def wants_collective_overlap(
     plugin: Optional[ParallelismPlugin], mesh: Optional[Mesh]
 ) -> bool:
@@ -297,9 +333,17 @@ def wants_collective_overlap(
     whose data axes actually span devices — exactly the paths where the
     step emits all-gather/reduce-scatter chains the latency-hiding
     scheduler can reorder (``compilation.overlap`` consumes this to
-    decide whether to emit the XLA overlap options)."""
+    decide whether to emit the XLA overlap options).
+
+    Also true — regardless of strategy, including pure-DP ``NO_SHARD`` —
+    when the mesh spans multiple slices and dp > 1: the gradient
+    reduction then crosses DCN every step, the single most important
+    collective to schedule first and hide (``compilation.overlap`` adds
+    the DCN-ranking options on top for this case)."""
     if plugin is None or mesh is None:
         return False
+    if mesh_num_slices(mesh) > 1 and int(mesh.shape[MESH_AXIS_DATA]) > 1:
+        return True
     if plugin.sharding_strategy == ShardingStrategy.NO_SHARD:
         return False
     return (

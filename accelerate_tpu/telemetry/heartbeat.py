@@ -55,6 +55,20 @@ def _default_generation() -> Optional[int]:
         return None
 
 
+def _default_fault_domain() -> Optional[int]:
+    """The slice id (fault domain) this process lives on — the elastic
+    supervisor exports it per rank on multi-slice runs. Stamped into
+    heartbeat records so a scanner (or ``diagnose``) can tell a single
+    wedged rank from a whole lost slice."""
+    from ..utils.constants import ENV_PREFIX
+
+    val = os.environ.get(ENV_PREFIX + "FAULT_DOMAIN")
+    try:
+        return int(val) if val is not None else None
+    except ValueError:
+        return None
+
+
 class HeartbeatMonitor:
     """Watchdog for the step loop of one process.
 
@@ -74,6 +88,7 @@ class HeartbeatMonitor:
         process_index: Optional[int] = None,
         on_stall: Optional[Callable[["HeartbeatMonitor"], None]] = None,
         generation: Optional[int] = None,
+        fault_domain: Optional[int] = None,
     ):
         if stall_timeout_s <= 0:
             raise ValueError("stall_timeout_s must be > 0")
@@ -85,6 +100,9 @@ class HeartbeatMonitor:
         )
         self.generation = (
             _default_generation() if generation is None else generation
+        )
+        self.fault_domain = (
+            _default_fault_domain() if fault_domain is None else fault_domain
         )
         self.on_stall = on_stall
         self.stalls = 0  # completed stall episodes observed
@@ -171,6 +189,8 @@ class HeartbeatMonitor:
         }
         if self.generation is not None:
             record["generation"] = self.generation
+        if self.fault_domain is not None:
+            record["fault_domain"] = self.fault_domain
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w") as f:

@@ -12,10 +12,19 @@ Spec grammar (``;``-separated specs, each ``action@step[:key=val...]``):
 * ``action``: ``kill`` (SIGKILL self — a hardware loss: no handlers, no
   final checkpoint), ``sigterm`` / ``sigint`` (delivered to self — the
   preemption path, handlers DO run), ``hang`` (sleep forever — the wedged
-  rank the heartbeat watchdog exists for).
+  rank the heartbeat watchdog exists for), ``dcn_stall`` (a slow or
+  blocked cross-slice DCN link: the rank stops making progress mid-step;
+  ``secs=S`` bounds the stall so a transient link blip recovers, ``secs``
+  unset/0 blocks until killed — detection is the heartbeat watchdog's
+  job, like ``hang``, but the name and the ``slice=`` gate make the
+  slice-level scenario explicit).
 * ``@step``: fire when :meth:`FaultInjector.maybe_fire` is called with
   exactly this step.
 * ``rank=R`` (default 0): only this process index fires.
+* ``slice=S``: only ranks whose fault domain (slice id, from the
+  ``ACCELERATE_TPU_FAULT_DOMAIN`` env the elastic supervisor exports) is
+  ``S`` fire — EVERY rank on the slice, overriding the ``rank=`` gate.
+  This is how one spec takes down a whole slice at once.
 * ``gen=G`` (default 0): only this elastic generation fires — a restarted
   survivor world re-reads the same env, so without the gate the fault
   would re-fire every generation and the run could never finish.
@@ -37,17 +46,19 @@ from ..utils.constants import ENV_PREFIX
 
 FAULT_ENV = ENV_PREFIX + "FAULT_INJECT"
 
-_ACTIONS = ("kill", "sigterm", "sigint", "hang")
+_ACTIONS = ("kill", "sigterm", "sigint", "hang", "dcn_stall")
 
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
-    """One parsed fault: ``action@step:rank=R:gen=G``."""
+    """One parsed fault: ``action@step:rank=R:gen=G[:slice=S][:secs=N]``."""
 
     action: str
     step: int
     rank: int = 0
     generation: int = 0
+    fault_domain: Optional[int] = None  # ``slice=`` gate; None = rank gate
+    stall_secs: float = 0.0  # ``secs=``; dcn_stall duration, 0 = forever
 
     @classmethod
     def parse(cls, text: str) -> "FaultSpec":
@@ -55,26 +66,38 @@ class FaultSpec:
         action, at, step = head.partition("@")
         if action not in _ACTIONS or at != "@":
             raise ValueError(
-                f"bad fault spec {text!r}: want 'action@step[:rank=R][:gen=G]' "
+                f"bad fault spec {text!r}: want "
+                f"'action@step[:rank=R][:gen=G][:slice=S][:secs=N]' "
                 f"with action in {_ACTIONS}"
             )
-        fields = {"rank": 0, "gen": 0}
+        fields = {"rank": 0, "gen": 0, "slice": None, "secs": 0.0}
         for part in filter(None, tail.split(":")):
             key, eq, val = part.partition("=")
             if key not in fields or eq != "=":
                 raise ValueError(
                     f"bad fault spec {text!r}: unknown field {part!r}"
                 )
-            fields[key] = int(val)
+            fields[key] = float(val) if key == "secs" else int(val)
+        if fields["secs"] and action != "dcn_stall":
+            raise ValueError(
+                f"bad fault spec {text!r}: secs= only applies to dcn_stall"
+            )
         return cls(
             action=action,
             step=int(step),
             rank=fields["rank"],
             generation=fields["gen"],
+            fault_domain=fields["slice"],
+            stall_secs=fields["secs"],
         )
 
     def render(self) -> str:
-        return f"{self.action}@{self.step}:rank={self.rank}:gen={self.generation}"
+        out = f"{self.action}@{self.step}:rank={self.rank}:gen={self.generation}"
+        if self.fault_domain is not None:
+            out += f":slice={self.fault_domain}"
+        if self.stall_secs:
+            out += f":secs={self.stall_secs:g}"
+        return out
 
 
 def render_specs(specs: Sequence[FaultSpec]) -> str:
@@ -85,10 +108,11 @@ def render_specs(specs: Sequence[FaultSpec]) -> str:
 class FaultInjector:
     """Fires the matching :class:`FaultSpec` at the matching step.
 
-    ``rank``/``generation`` default from the process env (the same
-    ``ACCELERATE_TPU_PROCESS_ID`` / ``ACCELERATE_TPU_ELASTIC_GENERATION``
-    the launcher/supervisor export), so ``FaultInjector.from_env()`` in
-    the training script needs no plumbing.
+    ``rank``/``generation``/``fault_domain`` default from the process env
+    (the same ``ACCELERATE_TPU_PROCESS_ID`` /
+    ``ACCELERATE_TPU_ELASTIC_GENERATION`` /
+    ``ACCELERATE_TPU_FAULT_DOMAIN`` the launcher/supervisor export), so
+    ``FaultInjector.from_env()`` in the training script needs no plumbing.
     """
 
     def __init__(
@@ -96,6 +120,7 @@ class FaultInjector:
         specs: Sequence[FaultSpec] = (),
         rank: Optional[int] = None,
         generation: Optional[int] = None,
+        fault_domain: Optional[int] = None,
     ):
         self.specs = list(specs)
         if rank is None:
@@ -104,8 +129,13 @@ class FaultInjector:
             generation = int(
                 os.environ.get(ENV_PREFIX + "ELASTIC_GENERATION", "0")
             )
+        if fault_domain is None:
+            fault_domain = int(
+                os.environ.get(ENV_PREFIX + "FAULT_DOMAIN", "0")
+            )
         self.rank = rank
         self.generation = generation
+        self.fault_domain = fault_domain
         self._fired: set[FaultSpec] = set()
 
     @classmethod
@@ -114,6 +144,13 @@ class FaultInjector:
         specs = [FaultSpec.parse(p) for p in raw.split(";") if p.strip()]
         return cls(specs, **kwargs)
 
+    def _placement_matches(self, spec: FaultSpec) -> bool:
+        # slice= gates on the fault domain and overrides rank= — the
+        # whole slice fires, which is what a slice-level fault looks like
+        if spec.fault_domain is not None:
+            return spec.fault_domain == self.fault_domain
+        return spec.rank == self.rank
+
     def maybe_fire(self, step: int) -> None:
         """Call once per step; executes at most once per matching spec."""
         for spec in self.specs:
@@ -121,7 +158,7 @@ class FaultInjector:
                 continue
             if (
                 spec.step == step
-                and spec.rank == self.rank
+                and self._placement_matches(spec)
                 and spec.generation == self.generation
             ):
                 self._fired.add(spec)
@@ -137,3 +174,9 @@ class FaultInjector:
         elif spec.action == "hang":
             while True:  # the watchdog's job is to notice this
                 time.sleep(3600.0)
+        elif spec.action == "dcn_stall":
+            if spec.stall_secs > 0:
+                time.sleep(spec.stall_secs)  # transient link blip: recovers
+            else:
+                while True:  # blocked link: watchdog territory, like hang
+                    time.sleep(3600.0)

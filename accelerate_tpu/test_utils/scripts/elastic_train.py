@@ -76,12 +76,17 @@ def main() -> int:
     from accelerate_tpu.test_utils.fault_injection import FaultInjector
     from accelerate_tpu.utils.dataclasses import ProjectConfiguration
 
+    # Multi-slice simulation (ACCELERATE_TPU_NUM_SLICES from the elastic
+    # supervisor): one dp group per slice so dp crosses DCN and fsdp
+    # stays inside each slice — the hierarchical layout. Single-slice
+    # runs keep the flat fsdp-over-the-world layout.
+    num_slices = int(os.environ.get("ACCELERATE_TPU_NUM_SLICES", "1"))
     acc = Accelerator(
         project_config=ProjectConfiguration(
             project_dir=workdir, automatic_checkpoint_naming=True
         ),
         parallelism_plugin=ParallelismPlugin(
-            dp_size=1, fsdp_size=-1, min_weight_size=1
+            dp_size=num_slices, fsdp_size=-1, min_weight_size=1
         ),
     )
     rank, world = acc.process_index, acc.num_processes

@@ -359,3 +359,78 @@ def test_validate_coverage_rejects_missing_shard_file(tmp_path):
     os.rename(shard, shard + ".lost")
     with pytest.raises(FileNotFoundError, match=os.path.basename(shard)):
         validate_coverage(out)
+
+
+# ---------------------------------------------------------------------- #
+# hierarchical (slice-major) process -> shard maps: a dropped slice must
+# fail coverage loudly, never restore a silently-torn checkpoint
+# ---------------------------------------------------------------------- #
+def _hierarchical_checkpoint(tmp_path, world=4):
+    """Synthetic per-process files with slice-major rank numbering: proc p
+    owns row-block p of one (8, 8) leaf, and with 2 procs per slice the
+    contiguous proc pairs (0,1) and (2,3) are the two fault domains."""
+    from accelerate_tpu.dist_checkpoint import ShardSnapshot, write_snapshot
+
+    out = str(tmp_path / "ck")
+    full = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+    rows = 8 // world
+    for p in range(world):
+        lo = p * rows
+        fname = f"state_shard_{p:05d}.safetensors"
+        snap = ShardSnapshot(
+            tensors={f"w@{p}": np.ascontiguousarray(full[lo:lo + rows])},
+            manifest={
+                "w": {
+                    "shape": [8, 8],
+                    "dtype": "float32",
+                    "chunks": [
+                        {
+                            "file": fname,
+                            "stored": f"w@{p}",
+                            "offset": [lo, 0],
+                            "shape": [rows, 8],
+                        }
+                    ],
+                }
+            },
+            process_index=p,
+        )
+        write_snapshot(snap, out)
+    return out, full
+
+
+def test_validate_coverage_accepts_hierarchical_process_map(tmp_path):
+    from accelerate_tpu.dist_checkpoint import validate_coverage
+
+    out, full = _hierarchical_checkpoint(tmp_path)
+    stats = validate_coverage(out)
+    assert stats == {"leaves": 1, "chunks": 4, "files": 4}
+    # the slice-major map assembles back into the global leaf
+    np.testing.assert_array_equal(load_full_named(out)["w"], full)
+
+
+def test_validate_coverage_rejects_dropped_slice_gap(tmp_path):
+    """Losing a whole slice (procs 2,3: index AND shard files gone) is a
+    row-region gap — coverage must name the leaf and refuse."""
+    from accelerate_tpu.dist_checkpoint import validate_coverage
+
+    out, _ = _hierarchical_checkpoint(tmp_path)
+    for p in (2, 3):
+        os.remove(os.path.join(out, f"state_index_{p:05d}.json"))
+        os.remove(os.path.join(out, f"state_shard_{p:05d}.safetensors"))
+    with pytest.raises(ValueError, match="'w'.*not covered"):
+        validate_coverage(out)
+
+
+def test_validate_coverage_rejects_dropped_slice_shards_only(tmp_path):
+    """The slice's manifests survived but its shard data did not (indexes
+    on shared storage, shards local): every missing file is named."""
+    from accelerate_tpu.dist_checkpoint import validate_coverage
+
+    out, _ = _hierarchical_checkpoint(tmp_path)
+    for p in (2, 3):
+        os.remove(os.path.join(out, f"state_shard_{p:05d}.safetensors"))
+    with pytest.raises(FileNotFoundError) as exc:
+        validate_coverage(out)
+    assert "state_shard_00002.safetensors" in str(exc.value)
+    assert "state_shard_00003.safetensors" in str(exc.value)

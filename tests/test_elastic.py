@@ -109,21 +109,79 @@ def test_injector_rank_and_generation_default_from_env(monkeypatch):
 
 
 # ---------------------------------------------------------------------- #
+# slice-level faults: the slice= gate and the dcn_stall action
+# ---------------------------------------------------------------------- #
+def test_fault_spec_slice_and_secs_roundtrip():
+    spec = FaultSpec.parse("kill@7:slice=1:gen=0")
+    assert spec == FaultSpec("kill", 7, rank=0, generation=0, fault_domain=1)
+    assert FaultSpec.parse(spec.render()) == spec
+
+    stall = FaultSpec.parse("dcn_stall@4:slice=1:secs=0.5")
+    assert stall.fault_domain == 1 and stall.stall_secs == 0.5
+    assert FaultSpec.parse(stall.render()) == stall
+
+
+def test_fault_spec_rejects_secs_on_non_stall_actions():
+    with pytest.raises(ValueError, match="secs= only applies to dcn_stall"):
+        FaultSpec.parse("kill@3:secs=5")
+
+
+def test_injector_slice_gate_overrides_rank():
+    spec = FaultSpec("sigterm", 3, rank=0, generation=0, fault_domain=1)
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda *a: hits.append(a))
+    try:
+        # domain 0, even on the spec's rank=0, must NOT fire: slice= wins
+        FaultInjector([spec], rank=0, generation=0, fault_domain=0).maybe_fire(3)
+        assert hits == []
+        # EVERY rank on domain 1 fires, regardless of its rank
+        for rank in (2, 3):
+            FaultInjector(
+                [spec], rank=rank, generation=0, fault_domain=1
+            ).maybe_fire(3)
+        assert len(hits) == 2
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_injector_fault_domain_defaults_from_env(monkeypatch):
+    monkeypatch.setenv(ENV + "FAULT_DOMAIN", "2")
+    assert FaultInjector([]).fault_domain == 2
+    monkeypatch.delenv(ENV + "FAULT_DOMAIN")
+    assert FaultInjector([]).fault_domain == 0
+
+
+def test_dcn_stall_with_secs_recovers():
+    """A bounded stall (transient DCN blip) sleeps and returns — the rank
+    lives on; only an unbounded stall is watchdog territory."""
+    inj = FaultInjector(
+        [FaultSpec("dcn_stall", 2, fault_domain=0, stall_secs=0.05)],
+        rank=0, generation=0, fault_domain=0,
+    )
+    t0 = time.monotonic()
+    inj.maybe_fire(2)
+    assert time.monotonic() - t0 >= 0.05
+    inj.maybe_fire(2)  # fired set: no second stall
+    assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------- #
 # liveness partitioning (the supervisor's death-declaration input)
 # ---------------------------------------------------------------------- #
-def _write_heartbeat(dir, rank, generation, age_s=0.0, step=1):
+def _write_heartbeat(dir, rank, generation, age_s=0.0, step=1,
+                     fault_domain=None):
+    record = {
+        "process_index": rank,
+        "pid": 1000 + rank,
+        "step": step,
+        "time_unix": time.time() - age_s,
+        "stalled": False,
+        "generation": generation,
+    }
+    if fault_domain is not None:
+        record["fault_domain"] = fault_domain
     with open(os.path.join(dir, f"heartbeat-rank{rank}.json"), "w") as f:
-        json.dump(
-            {
-                "process_index": rank,
-                "pid": 1000 + rank,
-                "step": step,
-                "time_unix": time.time() - age_s,
-                "stalled": False,
-                "generation": generation,
-            },
-            f,
-        )
+        json.dump(record, f)
 
 
 def test_partition_liveness_filters_stale_and_old_generations(tmp_path):
@@ -257,6 +315,125 @@ def test_supervisor_validates_bounds(tmp_path):
         ElasticSupervisor(["true"], num_processes=0)
     with pytest.raises(ValueError, match="min_processes"):
         ElasticSupervisor(["true"], num_processes=2, min_processes=3)
+    with pytest.raises(ValueError, match="num_slices"):
+        ElasticSupervisor(["true"], num_processes=4, num_slices=3)
+    with pytest.raises(ValueError, match="num_slices"):
+        ElasticSupervisor(["true"], num_processes=4, num_slices=0)
+
+
+# ---------------------------------------------------------------------- #
+# slice fault domains: whole-slice drop in ONE generation
+# ---------------------------------------------------------------------- #
+def test_supervisor_drops_whole_slice_on_one_rank_death(tmp_path):
+    """4 ranks in 2 slices; rank 2 dies -> its healthy slice-mate rank 3
+    is dropped WITH it, and the survivors re-form as a 1-slice world."""
+    code = (
+        "import os, sys\n"
+        f"r = int(os.environ['{ENV}PROCESS_ID'])\n"
+        f"g = int(os.environ['{ENV}ELASTIC_GENERATION'])\n"
+        f"s = int(os.environ['{ENV}NUM_SLICES'])\n"
+        f"d = int(os.environ['{ENV}FAULT_DOMAIN'])\n"
+        "assert s == (2 if g == 0 else 1), (g, s)\n"
+        "assert d == (r // 2 if g == 0 else 0), (g, r, d)\n"
+        "sys.exit(1 if (r == 2 and g == 0) else 0)\n"
+    )
+    sup = _supervisor(code, tmp_path, num_processes=4, min_processes=2,
+                      num_slices=2)
+    assert sup.run() == 0, [r.to_json() for r in sup.history]
+    assert [r.outcome for r in sup.history] == ["rank_death", "success"]
+    # the whole slice, in ONE generation — not one re-formation per rank
+    assert sup.history[0].dead_ranks == [2, 3]
+    assert sup.history[0].dead_domains == [1]
+    assert sup.history[0].num_slices == 2
+    assert sup.history[1].world == 2
+    assert sup.history[1].num_slices == 1
+
+    events = _events(sup)
+    slice_death = next(e for e in events if e["event"] == "slice_death")
+    assert slice_death["fault_domains"] == [1]
+    assert slice_death["victim_ranks"] == [2]
+    assert slice_death["dropped_ranks"] == [2, 3]
+    reform = next(e for e in events if e["event"] == "reforming")
+    assert reform["victim_ranks"] == [2, 3]
+    assert reform["old_num_slices"] == 2
+    assert reform["new_num_slices"] == 1
+
+
+def test_supervisor_declares_stale_slice_mates_together(tmp_path):
+    """Two ranks of the SAME slice wedge (backdated heartbeats — the
+    fake clock): the supervisor must declare them in ONE heartbeat_death,
+    so the whole slice costs ONE re-formation generation."""
+    code = (
+        "import os, sys, time\n"
+        f"r = int(os.environ['{ENV}PROCESS_ID'])\n"
+        f"g = int(os.environ['{ENV}ELASTIC_GENERATION'])\n"
+        "if g == 0 and r >= 2:\n"
+        "    time.sleep(120)\n"
+        "sys.exit(0)\n"
+    )
+    sup = _supervisor(
+        code, tmp_path, num_processes=4, min_processes=1, num_slices=2,
+        stall_timeout_s=1.0, generation_timeout_s=60.0,
+    )
+    # both backdated beats exist BEFORE the first scan — the fake clock
+    # must not race child spawn latency against the stall timeout
+    for rank, age in ((2, 200), (3, 100)):
+        _write_heartbeat(
+            sup.heartbeat_dir, rank, generation=0, age_s=age, step=1
+        )
+    assert sup.run() == 0, [r.to_json() for r in sup.history]
+    # exactly ONE re-formation: [gen0 rank_death, gen1 success]
+    assert [r.outcome for r in sup.history] == ["rank_death", "success"]
+    assert sup.history[0].dead_ranks == [2, 3]
+    assert sup.history[0].dead_domains == [1]
+    assert sup.history[1].world == 2
+
+    deaths = [e for e in _events(sup) if e["event"] == "heartbeat_death"]
+    assert len(deaths) == 1
+    # rank 2 (oldest beat) is the straggler; rank 3 shares its domain
+    assert deaths[0]["rank"] == 2
+    assert deaths[0]["victim_ranks"] == [2, 3]
+    assert deaths[0]["fault_domain"] == 1
+
+
+def test_elastic_events_schema(tmp_path):
+    """Every event in elastic-events.jsonl names its generation, and
+    every death/re-formation event names its victim ranks and fault
+    domains — the log must reconstruct the incident without the
+    supervisor's memory."""
+    code = (
+        "import os, sys\n"
+        f"r = int(os.environ['{ENV}PROCESS_ID'])\n"
+        f"g = int(os.environ['{ENV}ELASTIC_GENERATION'])\n"
+        "sys.exit(1 if (r == 3 and g == 0) else 0)\n"
+    )
+    sup = _supervisor(code, tmp_path, num_processes=4, min_processes=2,
+                      num_slices=2)
+    assert sup.run() == 0
+    events = _events(sup)
+    assert events, "no events written"
+    for e in events:
+        assert "generation" in e, e
+        assert "time_unix" in e, e
+        if e["event"] in (
+            "heartbeat_death", "slice_death", "rank_death",
+            "reforming", "giving_up",
+        ):
+            assert "victim_ranks" in e, e
+            assert "fault_domains" in e, e
+    starts = [e for e in events if e["event"] == "generation_start"]
+    assert [s["num_slices"] for s in starts] == [2, 1]
+
+
+def test_supervisor_single_slice_expansion_is_identity(tmp_path):
+    """num_slices=1 (the default) keeps the original single-victim
+    semantics: a lone death drops exactly one rank."""
+    sup = _supervisor("", tmp_path, num_processes=3)
+    expanded, domains = sup._expand_to_domains({1}, 3)
+    assert expanded == {1} and domains == []
+    sup2 = _supervisor("", tmp_path, num_processes=4, num_slices=2)
+    expanded, domains = sup2._expand_to_domains({1}, 4)
+    assert expanded == {0, 1} and domains == [0]
 
 
 # ---------------------------------------------------------------------- #
@@ -426,6 +603,126 @@ def test_reshaped_restore_folds_new_rank_into_keychain(tmp_path):
 
 
 # ---------------------------------------------------------------------- #
+# topology.json format_version 2: the slice layout stamp
+# ---------------------------------------------------------------------- #
+def test_topology_v2_stamps_num_slices_and_fault_domains(monkeypatch):
+    """A multi-slice save stamps format_version 2 with the slice layout:
+    top-level num_slices plus each process's fault_domain (slice-major)."""
+    from accelerate_tpu.checkpointing import topology_metadata
+    from accelerate_tpu.parallel.mesh import NUM_SLICES_ENV, build_mesh
+    from accelerate_tpu import ParallelismPlugin
+
+    monkeypatch.setenv(NUM_SLICES_ENV, "2")
+    mesh = build_mesh(
+        ParallelismPlugin(dp_size=2, fsdp_size=4, min_weight_size=1)
+    )
+
+    class _State:
+        def __init__(self):
+            self.mesh = mesh
+            self.num_devices = mesh.devices.size
+
+    class _Acc:
+        num_processes = 4
+        step = 5
+        state = _State()
+
+    topo = topology_metadata(_Acc())
+    assert topo["format_version"] == 2
+    assert topo["num_slices"] == 2
+    domains = {
+        p: entry["fault_domain"]
+        for p, entry in topo["process_shard_files"].items()
+    }
+    assert domains == {"0": 0, "1": 0, "2": 1, "3": 1}
+
+    # a world the slice count cannot tile refuses to stamp a layout a
+    # restore could not use
+    _Acc.num_processes = 3
+    assert topology_metadata(_Acc())["num_slices"] == 1
+
+
+def test_topology_v2_written_and_v1_still_loads(tmp_path):
+    """save_state writes format_version 2; a v1 checkpoint (new fields
+    stripped) keeps loading unchanged — the bump is purely additive."""
+    import optax
+
+    acc = _fresh_accelerator(tmp_path)
+    params = acc.prepare({"w": jnp.ones((8, 8))})
+    opt = acc.prepare(optax.sgd(0.1))
+    carry = acc.init_carry(params, opt)
+    out = acc.save_state(carry=carry)
+
+    with open(os.path.join(out, "topology.json")) as f:
+        topo = json.load(f)
+    assert topo["format_version"] == 2
+    assert topo["num_slices"] == 1
+    for entry in topo["process_shard_files"].values():
+        assert entry["fault_domain"] == 0
+
+    # strip back to v1 (as an old writer would have produced)
+    topo.pop("num_slices")
+    topo["format_version"] = 1
+    for entry in topo["process_shard_files"].values():
+        entry.pop("fault_domain")
+    with open(os.path.join(out, "topology.json"), "w") as f:
+        json.dump(topo, f)
+    restored = acc.load_state(out, carry=_zero_like(carry))
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), 1.0
+    )
+
+
+# ---------------------------------------------------------------------- #
+# restore_or_init: skipped checkpoints land in the flight recorder
+# ---------------------------------------------------------------------- #
+def test_restore_or_init_records_skipped_checkpoint(tmp_path):
+    """A committed-then-corrupted checkpoint is passed over with a
+    flight-recorder event naming it AT SKIP TIME — otherwise the
+    successful fallback hides that a checkpoint was lost."""
+    import glob as _glob
+    import optax
+    from accelerate_tpu.fault_tolerance import CheckpointManager
+
+    acc = _fresh_accelerator(tmp_path, diagnostics=str(tmp_path / "diag"))
+    params = acc.prepare({"w": jnp.ones((4, 4))})
+    opt = acc.prepare(optax.sgd(0.1))
+    carry = acc.init_carry(params, opt)
+    step = acc.unified_step(lambda p, b: jnp.mean(p["w"] ** 2))
+
+    manager = CheckpointManager(acc, every_n_steps=1, handle_signals=False)
+    carry, _ = step(carry, {"x": jnp.ones((4,))})
+    manager.step(carry)
+    first_w = np.asarray(carry["params"]["w"]).copy()
+    carry, _ = step(carry, {"x": jnp.ones((4,))})
+    manager.step(carry)
+    cks = sorted(
+        _glob.glob(os.path.join(str(tmp_path), "checkpoints", "checkpoint_*"))
+    )
+    assert len(cks) == 2
+    # corrupt the NEWEST checkpoint's shard file
+    newest = cks[-1]
+    for shard in _glob.glob(os.path.join(newest, "state_shard_*.safetensors")):
+        os.remove(shard)
+
+    restored, resumed = manager.restore_or_init(_zero_like(carry))
+    assert resumed
+    # the fallback resumed from the older, intact checkpoint
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), first_w
+    )
+    events = [
+        e
+        for e in acc.telemetry.diagnostics.recorder.events
+        if e["event"] == "checkpoint_skipped"
+    ]
+    assert len(events) == 1
+    assert events[0]["checkpoint"] == newest
+    assert events[0]["error"]
+    manager.close()
+
+
+# ---------------------------------------------------------------------- #
 # diagnose: the restartability verdict
 # ---------------------------------------------------------------------- #
 def test_diagnose_elastic_verdict_names_reshape(tmp_path):
@@ -495,6 +792,55 @@ def test_diagnose_elastic_not_restartable_without_committed_checkpoint(
     report = build_report(d, stall_timeout_s=300.0)
     assert report["elastic"]["restartable"] is False
     assert "NOT restartable" in format_report(report)
+
+
+def test_diagnose_names_lost_slice_on_hierarchical_topology(tmp_path):
+    """When the heartbeats carry fault domains and the checkpoint stamps
+    a hierarchical topology, the verdict names the failed slice and the
+    re-formed slice count, not just the survivor headcount."""
+    from accelerate_tpu.checkpoint_async import commit as cm
+    from accelerate_tpu.diagnostics.diagnose import build_report, format_report
+
+    d = str(tmp_path)
+    ck = os.path.join(d, "checkpoint_5")
+    work = cm.work_dir_for(ck)
+    os.makedirs(work)
+    cm.commit(
+        work, ck, process_index=0, world=1,
+        topology={
+            "format_version": 2, "world_size": 4, "num_devices": 4,
+            "num_slices": 2, "mesh_shape": {"dp": 2, "fsdp": 2}, "step": 5,
+        },
+    )
+    with open(os.path.join(d, "flightrec-rank0.json"), "w") as f:
+        json.dump(
+            {
+                "process_index": 0, "last_step": 9, "reason": "preemption",
+                "time_unix": time.time(), "dumps": 1, "records": [],
+                "last_checkpoint": {
+                    "dir": ck, "step": 5, "time_unix": time.time(),
+                },
+            },
+            f,
+        )
+    # slice 0 (ranks 0,1) beating; slice 1 (ranks 2,3) silent
+    for rank, age in [(0, 0.0), (1, 0.0), (2, 900.0), (3, 900.0)]:
+        _write_heartbeat(d, rank, generation=0, age_s=age, step=9,
+                         fault_domain=rank // 2)
+
+    report = build_report(d, stall_timeout_s=300.0)
+    elastic = report["elastic"]
+    assert elastic["survivors"] == [0, 1]
+    assert elastic["restartable"] is True
+    assert elastic["num_slices"] == 2
+    assert elastic["lost_slices"] == [1]
+
+    text = format_report(report)
+    assert (
+        "slice 1 of 2 lost; RESTARTABLE as 1-slice reshaped restore" in text
+    )
+    assert "from step 5" in text
+    assert "2 survivor(s) of 4" in text
 
 
 # ---------------------------------------------------------------------- #
@@ -611,6 +957,122 @@ def test_elastic_kill_and_reform(tmp_path):
     gen0 = _read_metrics(proj / "metrics-gen0-rank0.jsonl")
     assert el_metrics[-1]["loss"] < gen0[0]["loss"]
 
+    el_final = _read_json(proj / f"digest-final-gen{final_gen}-rank0.json")
+    ct_final = _read_json(ctl / "digest-final-gen0-rank0.json")
+    assert el_final["step"] == ct_final["step"] == 15
+    mismatched = [
+        k for k, v in el_final["digests"].items()
+        if ct_final["digests"].get(k) != v
+    ]
+    assert mismatched == []
+
+
+@pytest.mark.slow
+def test_slice_kill_and_reform(tmp_path):
+    """Slice-level acceptance (also `make slice-smoke`):
+
+    4-process CPU run simulating 2 slices of 2 ranks each (dp crosses
+    the simulated DCN, fsdp stays in-slice). `kill@7:slice=1` SIGKILLs
+    EVERY rank of slice 1 at step 7, after the step-5 cadence checkpoint
+    committed. The supervisor must drop the whole slice in ONE
+    generation and re-form the survivors as a 1-slice world; generation
+    1 restores the 2-slice checkpoint onto the 1-slice mesh (reshaped)
+    and trains to completion. A CONTROL run — a clean 2-process 1-slice
+    world started from a copy of exactly what generation 1 saw on disk —
+    must produce bitwise-identical restored state, per-step losses, and
+    final params + optimizer moments.
+    """
+    from accelerate_tpu.test_utils import path_in_accelerate_package
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = path_in_accelerate_package(
+        "test_utils", "scripts", "elastic_train.py"
+    )
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    snapshots = {}
+
+    def snapshot(generation, world):
+        if generation > 0:
+            dst = tmp_path / f"snap-gen{generation}"
+            shutil.copytree(proj, dst)
+            snapshots[generation] = dst
+
+    base_env = {
+        "ELASTIC_TEST_DIR": str(proj),
+        "ELASTIC_TEST_STEPS": "15",
+        "ELASTIC_TEST_EVERY": "5",
+        "PYTHONPATH": pkg_root,
+        # children must NOT inherit conftest's 8-fake-device XLA_FLAGS:
+        # each rank is one real CPU device in the multiprocess mesh
+        "XLA_FLAGS": "",
+    }
+    sup = ElasticSupervisor(
+        [sys.executable, script],
+        num_processes=4,
+        num_slices=2,
+        min_processes=2,
+        heartbeat_dir=str(tmp_path / "hb"),
+        stall_timeout_s=120.0,
+        grace_period_s=8.0,
+        max_generations=3,
+        generation_timeout_s=240.0,
+        generation_hook=snapshot,
+        env={**base_env, FAULT_ENV: "kill@7:slice=1:gen=0"},
+    )
+    assert sup.run() == 0, [r.to_json() for r in sup.history]
+    # the WHOLE slice dropped in ONE generation
+    assert [r.outcome for r in sup.history] == ["rank_death", "success"]
+    assert sup.history[0].dead_ranks == [2, 3]
+    assert sup.history[0].dead_domains == [1]
+    assert sup.history[0].num_slices == 2
+    final_gen = sup.history[-1].generation
+    assert sup.history[-1].world == 2
+    assert sup.history[-1].num_slices == 1
+    for rank in range(2):
+        assert (proj / f"DONE-rank{rank}").exists()
+    death = next(
+        e for e in _events(sup) if e["event"] == "rank_death"
+    )
+    assert death["fault_domains"] == [1]
+
+    # ---- control: clean 2-process 1-slice run from the same state ---- #
+    ctl = tmp_path / "ctl"
+    shutil.copytree(snapshots[1], ctl)
+    import glob as _glob
+
+    for pattern in ("metrics-*", "digest-*", "DONE-*"):
+        for stale in _glob.glob(str(ctl / pattern)):
+            os.remove(stale)
+    ctl_sup = ElasticSupervisor(
+        [sys.executable, script],
+        num_processes=2,
+        min_processes=2,
+        heartbeat_dir=str(tmp_path / "hb-ctl"),
+        stall_timeout_s=120.0,
+        grace_period_s=8.0,
+        max_generations=1,
+        generation_timeout_s=240.0,
+        env={**base_env, "ELASTIC_TEST_DIR": str(ctl)},
+    )
+    assert ctl_sup.run() == 0, [r.to_json() for r in ctl_sup.history]
+
+    # the reshaped restore (2-slice -> 1-slice) is bitwise what a clean
+    # 1-slice restore of the same checkpoint produces
+    el_restore = _read_json(proj / f"digest-restore-gen{final_gen}-rank0.json")
+    ct_restore = _read_json(ctl / "digest-restore-gen0-rank0.json")
+    assert el_restore["world"] == ct_restore["world"] == 2
+    assert el_restore["step"] == ct_restore["step"] == 5
+    assert el_restore["digests"] == ct_restore["digests"]
+
+    el_metrics = _read_metrics(proj / f"metrics-gen{final_gen}-rank0.jsonl")
+    ct_metrics = _read_metrics(ctl / "metrics-gen0-rank0.jsonl")
+    assert el_metrics == ct_metrics
+    assert el_metrics[0]["step"] == 5 and el_metrics[-1]["step"] == 14
+    gen0 = _read_metrics(proj / "metrics-gen0-rank0.jsonl")
+    assert el_metrics[-1]["loss"] < gen0[0]["loss"]
+
+    # final optimizer moments included: every leaf digest must match
     el_final = _read_json(proj / f"digest-final-gen{final_gen}-rank0.json")
     ct_final = _read_json(ctl / "digest-final-gen0-rank0.json")
     assert el_final["step"] == ct_final["step"] == 15
