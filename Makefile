@@ -32,11 +32,11 @@ FAST_FILES = \
   tests/test_diagnostics.py tests/test_benchmarks.py \
   tests/test_serving.py tests/test_serving_obs.py \
   tests/test_elastic.py tests/test_fused_kernels.py \
-  tests/test_slice_mesh.py
+  tests/test_slice_mesh.py tests/test_adapters.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
   diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke \
-  slice-smoke kernels-smoke
+  slice-smoke kernels-smoke lora-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -142,6 +142,17 @@ kernels-smoke:
 	  tests/test_fused_kernels.py::test_epilogue_kernel_bitwise_vs_reference \
 	  tests/test_fused_kernels.py::test_zero_retraces_after_warmup_with_fused_kernels
 	python bench.py dense
+
+# multi-tenant adapter acceptance on CPU (~30s): train a LoRA adapter
+# through unified_step (adapter-only carry), commit its checkpoint
+# through the atomic protocol, load it into a serving engine next to a
+# second adapter, and decode token-for-token equal to a single-tenant
+# reference — with the multi-adapter batch parity test as preflight
+# (slow-marked e2e, so it runs here but not in tier 1)
+lora-smoke:
+	JAX_PLATFORMS=cpu $(PYTEST) -q \
+	  tests/test_adapters.py::test_multi_adapter_batch_bitwise_matches_single_tenant \
+	  tests/test_adapters.py::test_lora_smoke_end_to_end
 
 # diagnostics end-to-end on CPU: a tiny train loop with an injected slow
 # step and an injected NaN gradient runs with the flight recorder on,

@@ -7,6 +7,14 @@ Reference: wonkyoc/accelerate (HF Accelerate 0.32.0.dev0). See SURVEY.md.
 __version__ = "0.1.0"
 
 from .accelerator import Accelerator
+from .adapters import (
+    AdapterRegistry,
+    LoraConfig,
+    init_adapter,
+    load_adapter,
+    lora_loss_fn,
+    save_adapter,
+)
 from .big_modeling import (
     OffloadedLeaf,
     cpu_offload,
@@ -120,4 +128,10 @@ __all__ = [
     "ServingEngine",
     "SLOConfig",
     "TokenEvent",
+    "AdapterRegistry",
+    "LoraConfig",
+    "init_adapter",
+    "load_adapter",
+    "lora_loss_fn",
+    "save_adapter",
 ]

@@ -1,0 +1,50 @@
+"""Multi-tenant adapters: LoRA/QLoRA training + batched multi-adapter
+serving over one base model.
+
+Training: :func:`lora_loss_fn` differentiates ONLY the adapter tree
+against a frozen (optionally quantized) base — the carry that threads
+through ``Accelerator.unified_step`` holds adapter leaves alone. Serving:
+:class:`AdapterRegistry` keeps tenants resident in fixed-shape gathered
+stacks indexed per-slot as traced data, so one compiled decode program
+serves every tenant with zero retraces. Checkpoints: tiny
+``adapter_<name>`` artifacts through the atomic commit protocol.
+"""
+
+from .checkpoint import (
+    adapter_dir,
+    list_adapters,
+    load_adapter,
+    save_adapter,
+)
+from .lora import (
+    ALL_TARGETS,
+    LoraConfig,
+    adapter_num_bytes,
+    adapter_num_params,
+    assert_adapter_only,
+    build_lora_state,
+    init_adapter,
+    lora_loss_fn,
+    target_shapes,
+)
+from .registry import AdapterRegistry
+from .runtime import LoraState, lora_delta
+
+__all__ = [
+    "ALL_TARGETS",
+    "AdapterRegistry",
+    "LoraConfig",
+    "LoraState",
+    "adapter_dir",
+    "adapter_num_bytes",
+    "adapter_num_params",
+    "assert_adapter_only",
+    "build_lora_state",
+    "init_adapter",
+    "list_adapters",
+    "load_adapter",
+    "lora_delta",
+    "lora_loss_fn",
+    "save_adapter",
+    "target_shapes",
+]

@@ -1,0 +1,230 @@
+"""LoRA/QLoRA training over the native Llama-layout model.
+
+The recipe (Hu et al., 2021 / Dettmers et al., 2023): freeze the base —
+optionally int4/int8 via :mod:`..utils.quantization` — and train tiny
+low-rank ``A``/``B`` deltas on the projection modules. Here the adapter
+tree is the ONLY thing the optimizer ever sees: :func:`lora_loss_fn`
+closes over the frozen base (behind ``jax.lax.stop_gradient``, so base
+gradients are identically zero, not just unoptimized) and differentiates
+w.r.t. the adapter tree alone, which threads through the existing
+``Accelerator.unified_step`` unchanged — the fused-adamw epilogue either
+applies to the adapter tree or declines gracefully, by design.
+
+Adapter trees are ``{target: {"lora_a": (L, in, r), "lora_b":
+(L, r, out)}}`` — the leading layer axis matches the model's ``nn.scan``
+stacked-parameter layout, so one adapter leaf per target covers every
+layer (and slices per layer on the unrolled path too).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import TransformerConfig
+from .runtime import A_KEY, B_KEY, LoraState, stack_adapter
+
+#: every module LoRA can target (the 7 Llama-layout projections)
+ALL_TARGETS = (
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+)
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    target_modules: tuple = ("q_proj", "v_proj")
+    dropout: float = 0.0
+
+    def __post_init__(self):
+        if self.rank <= 0:
+            raise ValueError(f"rank must be positive, got {self.rank}")
+        if not (0.0 <= self.dropout < 1.0):
+            raise ValueError(f"dropout must be in [0, 1), got {self.dropout}")
+        object.__setattr__(
+            self, "target_modules", tuple(self.target_modules)
+        )
+        unknown = [t for t in self.target_modules if t not in ALL_TARGETS]
+        if unknown:
+            raise ValueError(
+                f"unknown target_modules {unknown}; "
+                f"supported: {', '.join(ALL_TARGETS)}"
+            )
+        if not self.target_modules:
+            raise ValueError("target_modules must name at least one module")
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+    def to_dict(self) -> dict:
+        return {
+            "rank": self.rank,
+            "alpha": self.alpha,
+            "target_modules": list(self.target_modules),
+            "dropout": self.dropout,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LoraConfig":
+        return cls(
+            rank=int(d["rank"]),
+            alpha=float(d["alpha"]),
+            target_modules=tuple(d["target_modules"]),
+            dropout=float(d.get("dropout", 0.0)),
+        )
+
+
+def target_shapes(cfg: TransformerConfig) -> dict[str, tuple[int, int]]:
+    """(in_features, out_features) per targetable projection — the
+    native module shapes in ``models/transformer.py``."""
+    h = cfg.hidden_size
+    q_dim = cfg.num_heads * cfg.head_dim
+    kv_dim = cfg.num_kv_heads * cfg.head_dim
+    f = cfg.intermediate_size
+    return {
+        "q_proj": (h, q_dim),
+        "k_proj": (h, kv_dim),
+        "v_proj": (h, kv_dim),
+        "o_proj": (q_dim, h),
+        "gate_proj": (h, f),
+        "up_proj": (h, f),
+        "down_proj": (f, h),
+    }
+
+
+def init_adapter(
+    rng: jax.Array,
+    model_config: TransformerConfig,
+    lora_config: LoraConfig,
+    dtype: Any = jnp.float32,
+) -> dict:
+    """A fresh adapter: A ~ N(0, 0.02), B = 0 — so a freshly-initialized
+    adapter's delta is EXACTLY zero and the adapted model starts bitwise
+    at the base model's outputs (the LoRA init contract)."""
+    shapes = target_shapes(model_config)
+    L, r = model_config.num_layers, lora_config.rank
+    adapter = {}
+    for t in lora_config.target_modules:
+        in_dim, out_dim = shapes[t]
+        rng, sub = jax.random.split(rng)
+        adapter[t] = {
+            A_KEY: 0.02 * jax.random.normal(sub, (L, in_dim, r), dtype),
+            B_KEY: jnp.zeros((L, r, out_dim), dtype),
+        }
+    return adapter
+
+
+def adapter_num_params(
+    model_config: TransformerConfig, lora_config: LoraConfig
+) -> int:
+    """``sum over targets of L * r * (in + out)`` — the sizing formula
+    (bytes = this * 4 at fp32; see README "Multi-tenant adapters")."""
+    shapes = target_shapes(model_config)
+    L, r = model_config.num_layers, lora_config.rank
+    return sum(
+        L * r * (shapes[t][0] + shapes[t][1])
+        for t in lora_config.target_modules
+    )
+
+
+def adapter_num_bytes(tree: Any) -> int:
+    return sum(
+        int(np.prod(l.shape)) * jnp.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(tree)
+    )
+
+
+def build_lora_state(
+    adapter_params: dict,
+    lora_config: LoraConfig,
+    batch_size: int,
+    deterministic: bool = True,
+) -> LoraState:
+    """Wrap one adapter tree as a capacity-1 ``LoraState`` — every batch
+    row indexes stack row 0. Training runs the exact same gather math the
+    multi-tenant serving stack does."""
+    return LoraState(
+        stacks=stack_adapter(adapter_params),
+        slot_ids=jnp.zeros((batch_size,), jnp.int32),
+        scales=jnp.asarray([lora_config.scaling], jnp.float32),
+        dropout_rate=lora_config.dropout,
+        deterministic=deterministic,
+    )
+
+
+def lora_loss_fn(
+    model,
+    base_params: Any,
+    lora_config: LoraConfig,
+    compute_dtype: Any = None,
+):
+    """Next-token CE closure for ``Accelerator.unified_step`` whose
+    differentiated tree is the ADAPTER, not the model.
+
+    ``fn(adapter_params, batch)`` with batch {input_ids, [loss_mask],
+    [dropout_seed]}. The frozen base (plain or quantized — quantized
+    leaves dequantize to ``compute_dtype`` on the fly, QLoRA-style) sits
+    behind ``jax.lax.stop_gradient``: d(loss)/d(base) is bitwise zero and
+    XLA never materializes base gradient buffers. LoRA dropout activates
+    only when the config asks for it AND the batch carries a
+    ``dropout_seed`` (per-step int32); otherwise the pass is
+    deterministic.
+    """
+    from ..utils.quantization import dequantize_tree
+
+    def fn(adapter_params, batch):
+        ids = batch["input_ids"]
+        base = jax.lax.stop_gradient(
+            dequantize_tree(base_params, compute_dtype)
+        )
+        use_dropout = lora_config.dropout > 0.0 and "dropout_seed" in batch
+        state = build_lora_state(
+            adapter_params, lora_config, ids.shape[0],
+            deterministic=not use_dropout,
+        )
+        rngs = (
+            {"dropout": jax.random.PRNGKey(batch["dropout_seed"])}
+            if use_dropout else None
+        )
+        logits = model.apply({"params": base}, ids, lora=state, rngs=rngs)
+        targets = ids[:, 1:]
+        logits = logits[:, :-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        mask = batch.get("loss_mask")
+        if mask is not None:
+            mask = mask[:, 1:].astype(jnp.float32)
+            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.mean(nll)
+
+    fn.fused_kernels = bool(getattr(model.config, "fused_kernels", False))
+    return fn
+
+
+def assert_adapter_only(tree: Any, lora_config: LoraConfig) -> None:
+    """Raise unless ``tree`` is exactly an adapter tree (the acceptance
+    assertion that the optimizer carry holds ONLY adapter leaves — no
+    frozen-base leaf ever entered the optimizer)."""
+    if not isinstance(tree, dict):
+        raise AssertionError(f"adapter tree must be a dict, got {type(tree)}")
+    extra = set(tree) - set(lora_config.target_modules)
+    missing = set(lora_config.target_modules) - set(tree)
+    if extra or missing:
+        raise AssertionError(
+            f"carry is not adapter-only: extra keys {sorted(extra)}, "
+            f"missing keys {sorted(missing)}"
+        )
+    for t, pair in tree.items():
+        keys = set(pair)
+        if keys != {A_KEY, B_KEY}:
+            raise AssertionError(
+                f"target {t!r} must hold exactly {{lora_a, lora_b}}, "
+                f"got {sorted(keys)}"
+            )

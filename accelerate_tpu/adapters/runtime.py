@@ -1,0 +1,153 @@
+"""Traced LoRA math: the one delta formula training AND serving share.
+
+The whole multi-tenant story rests on a single invariant — the adapter
+contribution is computed the SAME way whether the adapter tree is a
+differentiated capacity-1 stack (training) or one row of a resident
+multi-adapter stack (serving):
+
+    delta(x) = scale[id] * ((dropout(x) @ A[id]) @ B[id])
+
+``A``/``B`` live in fixed-capacity stacks ``(A_cap, in, r)`` /
+``(A_cap, r, out)`` (per layer, ``(L, A_cap, ...)`` before ``nn.scan``
+strips the leading axis) and ``id`` is per-batch-row TRACED data — the
+per-slot-temperatures idiom from the serving engine, applied to weights.
+Loading or evicting an adapter rewrites rows of the stack; shapes never
+change, so the compiled program never retraces. Row 0 is reserved as the
+all-zero identity adapter (rows without an adapter gather zeros — exact,
+not approximate), and an adapter of rank ``r < r_max`` pads A's columns
+and B's rows with zeros, which contributes exactly 0 to the product —
+rank padding is mathematically exact, not a tolerance.
+
+This module is deliberately model-free (no imports from ``..models``) so
+``models/transformer.py`` can import it without a cycle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax
+import jax
+import jax.numpy as jnp
+
+#: stack key names inside a per-target adapter pair
+A_KEY = "lora_a"
+B_KEY = "lora_b"
+
+
+@flax.struct.dataclass
+class LoraState:
+    """Everything the forward pass needs to apply adapters.
+
+    ``stacks``: ``{target: {"lora_a": (L, A, in, r), "lora_b":
+    (L, A, r, out)}}`` — scanned over the leading layer axis by
+    ``_apply_layer_stack`` (inside a block the leading ``L`` is gone).
+    ``slot_ids``: ``(B,)`` int32 — which stack row each batch row uses
+    (0 = the identity adapter). ``scales``: ``(A,)`` float32 — per-row
+    ``alpha / rank``. Dropout fields are static (they change the traced
+    program, not its data).
+    """
+
+    stacks: Any
+    slot_ids: jax.Array
+    scales: jax.Array
+    dropout_rate: float = flax.struct.field(pytree_node=False, default=0.0)
+    deterministic: bool = flax.struct.field(pytree_node=False, default=True)
+
+    def context(self) -> "LoraState":
+        """The broadcast half (everything but the scanned stacks) —
+        what rides next to ``positions``/``mask`` through ``nn.scan``."""
+        return self.replace(stacks=None)
+
+
+def lora_delta(
+    x: jax.Array,
+    pair: dict,
+    slot_ids: jax.Array,
+    scales: jax.Array,
+) -> jax.Array:
+    """The gathered low-rank delta for one projection.
+
+    ``x``: ``(B, S, in)`` activations; ``pair``: ``{"lora_a": (A, in, r),
+    "lora_b": (A, r, out)}`` (layer axis already scanned away);
+    ``slot_ids``: ``(B,)``; ``scales``: ``(A,)``. Returns ``(B, S, out)``
+    in ``x.dtype``. Row b reads ONLY stack row ``slot_ids[b]`` — what the
+    other rows hold cannot perturb its value, which is why a mixed batch
+    is bitwise-identical per tenant to a single-tenant batch.
+    """
+    a = jnp.take(pair[A_KEY], slot_ids, axis=0).astype(x.dtype)  # (B, in, r)
+    b = jnp.take(pair[B_KEY], slot_ids, axis=0).astype(x.dtype)  # (B, r, out)
+    s = jnp.take(scales, slot_ids, axis=0).astype(x.dtype)  # (B,)
+    h = jnp.einsum("bsi,bir->bsr", x, a)
+    return jnp.einsum("bsr,bro->bso", h, b) * s[:, None, None]
+
+
+def stack_adapter(adapter_params: Any) -> Any:
+    """A single adapter tree ``{target: {lora_a: (L, in, r), lora_b:
+    (L, r, out)}}`` -> capacity-1 stacks ``(L, 1, in, r)`` — the training
+    form: one tenant, same gather math as serving."""
+    return jax.tree.map(lambda l: l[:, None], adapter_params)
+
+
+def pad_rank(arr: jax.Array, axis: int, r_max: int) -> jax.Array:
+    """Zero-pad an adapter leaf's rank axis up to ``r_max`` (exact: zero
+    columns of A / rows of B contribute exactly 0 to A @ B)."""
+    r = arr.shape[axis]
+    if r > r_max:
+        raise ValueError(f"adapter rank {r} exceeds registry max rank {r_max}")
+    if r == r_max:
+        return arr
+    pad = [(0, 0)] * arr.ndim
+    pad[axis] = (0, r_max - r)
+    return jnp.pad(arr, pad)
+
+
+def empty_stacks(
+    target_shapes: dict[str, tuple[int, int]],
+    num_layers: int,
+    capacity: int,
+    rank: int,
+    dtype: Any = jnp.float32,
+) -> dict:
+    """All-zero fixed-capacity stacks for a registry: ``{target:
+    {"lora_a": (L, cap, in, r), "lora_b": (L, cap, r, out)}}``. Every
+    row starts as the identity adapter (zero delta)."""
+    stacks = {}
+    for target, (in_dim, out_dim) in target_shapes.items():
+        stacks[target] = {
+            A_KEY: jnp.zeros((num_layers, capacity, in_dim, rank), dtype),
+            B_KEY: jnp.zeros((num_layers, capacity, rank, out_dim), dtype),
+        }
+    return stacks
+
+
+def write_adapter_row(
+    stacks: dict,
+    slot: int,
+    adapter_params: Any,
+    r_max: Optional[int] = None,
+) -> dict:
+    """Functionally write one adapter into stack row ``slot`` (rank-
+    padded); targets the adapter does not carry stay zero (identity).
+    Returns new stacks — shapes unchanged, so consumers never retrace."""
+    out = {}
+    for target, pair in stacks.items():
+        if adapter_params is not None and target in adapter_params:
+            a = pad_rank(
+                jnp.asarray(adapter_params[target][A_KEY], pair[A_KEY].dtype),
+                axis=-1, r_max=r_max or pair[A_KEY].shape[-1],
+            )
+            b = pad_rank(
+                jnp.asarray(adapter_params[target][B_KEY], pair[B_KEY].dtype),
+                axis=-2, r_max=r_max or pair[B_KEY].shape[-2],
+            )
+            out[target] = {
+                A_KEY: pair[A_KEY].at[:, slot].set(a),
+                B_KEY: pair[B_KEY].at[:, slot].set(b),
+            }
+        else:
+            out[target] = {
+                A_KEY: pair[A_KEY].at[:, slot].set(0.0),
+                B_KEY: pair[B_KEY].at[:, slot].set(0.0),
+            }
+    return out

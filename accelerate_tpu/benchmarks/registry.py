@@ -25,7 +25,7 @@ ENV_ITERS = "ACCELERATE_TPU_BENCH_ITERS"  # test/debug: stretch train loops
 @dataclass(frozen=True)
 class Variant:
     name: str
-    kind: str  # "train" | "ckpt" | "accum" | "decode" | "decode_load" | "serve" | "overhead"
+    kind: str  # "train" | "ckpt" | "accum" | "decode" | "decode_load" | "serve" | "overhead" | "lora"
     priority: int
     group: str
     args: tuple = field(default_factory=tuple)
@@ -160,6 +160,11 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
                      default_estimate_s=110),
             _variant("ckpt", "ckpt", 3, "ckpt", (tiny, 4, 64, 8, 2),
                      fast=True, default_estimate_s=15),
+            # adapter-only vs full fine-tune economics + the multi-tenant
+            # zero-retrace serving check; shares the dense group's tiny
+            # config so it rides the same warm compile cache
+            _variant("lora", "lora", 2, "lora", (tiny, 4, 64, 3, 1),
+                     fast=True, default_estimate_s=40),
         ])
 
     import dataclasses
@@ -343,4 +348,10 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
         # never perturb the throughput headlines
         _variant("ckpt", "ckpt", 8, "ckpt", (small, 8, 512, 16, 3),
                  fast=True, default_estimate_s=600),
+        # adapter-only vs full fine-tune on the small shape + the
+        # multi-tenant zero-retrace serving check; its own group (the
+        # serving phase's engine compiles must not warm-start a
+        # throughput sibling's cache accounting)
+        _variant("lora", "lora", 8, "lora", (small, 4, 512, 8, 2),
+                 default_estimate_s=600),
     ])

@@ -216,15 +216,44 @@ class _ProjParams(nn.Module):
         return kernel, bias
 
 
+def _lora_delta_fn(module: nn.Module, lora, lora_stacks):
+    """Per-projection LoRA delta closure for Attention/MLP.
+
+    Returns ``delta(inp, name) -> array | None``: the gathered low-rank
+    contribution for projection ``name`` (None when the adapter state
+    doesn't target it). Dropout (training only) is applied to the delta's
+    INPUT — the standard LoRA placement — via an nn.Dropout owned by the
+    calling module, so it needs a "dropout" rng only when actually live.
+    """
+    if lora is None or lora_stacks is None:
+        return lambda inp, name: None
+    from ..adapters.runtime import lora_delta
+
+    def delta(inp, name):
+        pair = lora_stacks.get(name) if hasattr(lora_stacks, "get") else None
+        if pair is None:
+            return None
+        z = inp
+        if lora.dropout_rate > 0.0 and not lora.deterministic:
+            z = nn.Dropout(lora.dropout_rate, name=f"lora_drop_{name}")(
+                z, deterministic=False
+            )
+        return lora_delta(z, pair, lora.slot_ids, lora.scales)
+
+    return delta
+
+
 class Attention(nn.Module):
     config: TransformerConfig
     decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions, mask=None, kv_lengths=None,
-                 paged=None, layer_window=None, pre_norm_scale=None):
+                 paged=None, layer_window=None, pre_norm_scale=None,
+                 lora=None, lora_stacks=None):
         decode = self.decode
         cfg = self.config
+        delta = _lora_delta_fn(self, lora, lora_stacks)
         # static homogeneous band, or the per-layer traced one (Gemma-2)
         window = cfg.sliding_window if layer_window is None else layer_window
         # Gemma-2 decouples the attention scale from head_dim
@@ -247,9 +276,17 @@ class Attention(nn.Module):
             # (exact RMSNorm math) and fall through unfused.
             from ..ops import fused as fused_ops
 
+            # LoRA on q/k/v has to add its delta to the raw projection
+            # outputs, which the fused kernel never materializes — force
+            # the exact unfused fallback when any qkv target is adapted
+            # (o_proj-only adapters keep the fused prologue)
+            lora_on_qkv = lora_stacks is not None and any(
+                t in lora_stacks for t in ("q_proj", "k_proj", "v_proj")
+            )
             if (
                 not self.decode
                 and not cfg.fp8
+                and not lora_on_qkv
                 and fused_ops.prologue_supported(
                     cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
                     b, s, x.shape[-1],
@@ -293,6 +330,15 @@ class Attention(nn.Module):
                 "v_proj", kv_dim, ("embed", "kv"),
                 use_bias=cfg.qkv_bias, bias_axis="kv",
             )(x)
+            dq = delta(x, "q_proj")
+            if dq is not None:
+                q = q + dq
+            dk = delta(x, "k_proj")
+            if dk is not None:
+                k = k + dk
+            dv = delta(x, "v_proj")
+            if dv is not None:
+                v = v + dv
             q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
             k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
             v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
@@ -403,7 +449,11 @@ class Attention(nn.Module):
         # so backward never recomputes the attention kernel
         out = checkpoint_name(out, "attn_out")
         out = out.reshape(b, s, q_dim)
-        return proj("o_proj", cfg.hidden_size, ("heads", "embed"))(out)
+        y = proj("o_proj", cfg.hidden_size, ("heads", "embed"))(out)
+        do = delta(out, "o_proj")
+        if do is not None:
+            y = y + do
+        return y
 
 
 class MLP(nn.Module):
@@ -412,32 +462,38 @@ class MLP(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, lora=None, lora_stacks=None):
         cfg = self.config
         dtype = _dtype(cfg)
         proj = _make_proj(cfg, dtype)
+        delta = _lora_delta_fn(self, lora, lora_stacks)
 
         # named so the "save_mlp" remat policy can keep exactly these two
         # f-wide activations (the expensive recompute in backward) while
         # everything else recomputes — the long-context middle ground
         # between "full" (recomputes all matmuls) and "dots" (saves every
         # matmul output, OOM at S=8192 on 16G)
-        gate = checkpoint_name(
-            proj("gate_proj", cfg.intermediate_size, ("embed", "mlp"))(x),
-            "mlp_gate_out",
-        )
-        up = checkpoint_name(
-            proj("up_proj", cfg.intermediate_size, ("embed", "mlp"))(x),
-            "mlp_up_out",
-        )
+        gate = proj("gate_proj", cfg.intermediate_size, ("embed", "mlp"))(x)
+        dg = delta(x, "gate_proj")
+        if dg is not None:
+            gate = gate + dg
+        gate = checkpoint_name(gate, "mlp_gate_out")
+        up = proj("up_proj", cfg.intermediate_size, ("embed", "mlp"))(x)
+        du = delta(x, "up_proj")
+        if du is not None:
+            up = up + du
+        up = checkpoint_name(up, "mlp_up_out")
         act = (
             nn.silu
             if cfg.mlp_activation == "silu"
             else lambda z: nn.gelu(z, approximate=True)  # Gemma gelu_tanh
         )
-        return proj("down_proj", cfg.hidden_size, ("mlp", "embed"))(
-            act(gate) * up
-        )
+        mid = act(gate) * up
+        y = proj("down_proj", cfg.hidden_size, ("mlp", "embed"))(mid)
+        dd = delta(mid, "down_proj")
+        if dd is not None:
+            y = y + dd
+        return y
 
 
 class MoE(nn.Module):
@@ -586,10 +642,30 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, mask=None, kv_lengths=None,
-                 paged=None, layer_window=None):
+                 paged=None, lora=None, scanned=None):
         from ..parallel.sharding import constrain_activations
 
         cfg = self.config
+        # ``scanned`` is this layer's slice of the per-layer traced data:
+        # either the bare layer-window array (the pre-adapter form) or a
+        # dict {"window": ..., "lora": {target: {lora_a, lora_b}}} — both
+        # shapes ride nn.scan's in_axes=0 the same way, the dict just
+        # scans every leaf
+        if isinstance(scanned, dict):
+            layer_window = scanned.get("window")
+            lora_scan = scanned.get("lora")
+        else:
+            layer_window, lora_scan = scanned, None
+        attn_lora = mlp_lora = None
+        if lora_scan is not None:
+            attn_lora = {
+                t: p for t, p in lora_scan.items()
+                if t in ("q_proj", "k_proj", "v_proj", "o_proj")
+            } or None
+            mlp_lora = {
+                t: p for t, p in lora_scan.items()
+                if t in ("gate_proj", "up_proj", "down_proj")
+            } or None
         if cfg.fused_kernels:
             # fused prologue: hand Attention the raw residual stream plus
             # the norm scale so ops/fused.py can run norm -> qkv -> rope
@@ -598,20 +674,28 @@ class Block(nn.Module):
             attn_scale = RMSNorm(cfg, name="attn_norm", param_only=True)(x)
             attn_out = Attention(cfg, decode=self.decode, name="attn")(
                 x, positions, mask, kv_lengths, paged, layer_window,
-                pre_norm_scale=attn_scale,
+                pre_norm_scale=attn_scale, lora=lora, lora_stacks=attn_lora,
             )
         else:
             attn_out = Attention(cfg, decode=self.decode, name="attn")(
                 RMSNorm(cfg, name="attn_norm")(x), positions, mask,
                 kv_lengths, paged, layer_window,
+                lora=lora, lora_stacks=attn_lora,
             )
         if cfg.post_norms:
             # Gemma-2 block: a norm AFTER each sublayer too (pre + post,
             # 4 per block — transformers Gemma2DecoderLayer)
             attn_out = RMSNorm(cfg, name="post_attn_norm")(attn_out)
         h = checkpoint_name(x + attn_out, "attn_res")
-        ff = MoE(cfg, name="moe") if cfg.num_experts > 0 else MLP(cfg, name="mlp")
-        ff_out = ff(RMSNorm(cfg, name="mlp_norm")(h))
+        if cfg.num_experts > 0:
+            # MoE blocks don't take adapters (the expert weights are the
+            # specialization mechanism there); attention adapters still apply
+            ff_out = MoE(cfg, name="moe")(RMSNorm(cfg, name="mlp_norm")(h))
+        else:
+            ff_out = MLP(cfg, name="mlp")(
+                RMSNorm(cfg, name="mlp_norm")(h),
+                lora=lora, lora_stacks=mlp_lora,
+            )
         if cfg.post_norms:
             ff_out = RMSNorm(cfg, name="post_mlp_norm")(ff_out)
         # pin the residual stream's layout once per layer so GSPMD cannot
@@ -692,11 +776,14 @@ def _apply_layer_stack(cfg: TransformerConfig, x, *extra, decode=False,
     SequenceClassifier and the seq2seq decoder share one implementation.
 
     ``extra``: per-call broadcast arguments of the block (positions, mask,
-    memory, ...). ``per_layer``: an optional (num_layers, ...) array
-    passed as the block's LAST positional argument, scanned over its
-    leading axis (the Gemma-2 per-layer window). ``block_cls``: defaults
-    to :class:`Block`; the seq2seq decoder passes
-    :class:`~.seq2seq.DecoderBlock`. Blocks must return ``(x, None)``.
+    memory, ...). ``per_layer``: an optional pytree whose every leaf has a
+    leading (num_layers, ...) axis, passed as the block's LAST positional
+    argument and scanned over that axis — the Gemma-2 per-layer window
+    array, or the adapters' {"window", "lora"} dict (nn.scan's in_axes
+    applies per-ARGUMENT, so a dict of stacks scans exactly like a bare
+    array). ``block_cls``: defaults to :class:`Block`; the seq2seq decoder
+    passes :class:`~.seq2seq.DecoderBlock`. Blocks must return
+    ``(x, None)``.
     """
     base_cls = block_cls or Block
     block_kwargs = {"decode": decode}  # every block class supports decode
@@ -719,14 +806,22 @@ def _apply_layer_stack(cfg: TransformerConfig, x, *extra, decode=False,
         x, _ = nn.scan(
             cls,
             variable_axes={"params": 0, "intermediates": 0, "cache": 0},
-            split_rngs={"params": True},
+            # "dropout": LoRA delta dropout inside the scanned block — the
+            # entry is inert unless a dropout rng is actually passed to
+            # apply (adapter training with LoraConfig.dropout > 0)
+            split_rngs={"params": True, "dropout": True},
             in_axes=in_axes,
             length=n,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(cfg, **block_kwargs, name="layers")(x, *args)
     else:
         for i in range(n):
-            args = extra if per_layer is None else extra + (per_layer[i],)
+            if per_layer is None:
+                args = extra
+            else:
+                # slice EVERY leaf's layer axis (per_layer may be a dict
+                # of adapter stacks, not just the bare window array)
+                args = extra + (jax.tree.map(lambda l: l[i], per_layer),)
             x, _ = cls(cfg, **block_kwargs, name=f"layer_{i}")(x, *args)
     return x
 
@@ -741,7 +836,7 @@ class CausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, mask=None, decode=False,
-                 paged=None):
+                 paged=None, lora=None):
         cfg = self.config
         dtype = _dtype(cfg)
         if positions is None:
@@ -755,12 +850,23 @@ class CausalLM(nn.Module):
         if cfg.embed_scale:  # Gemma scales embeddings by sqrt(hidden)
             x = x * jnp.asarray(np.sqrt(cfg.hidden_size), x.dtype)
         x = constrain_activations(x)
-        # the explicit None fills the block's kv_lengths slot (and paged
-        # fills its own) so the per-layer window array (if any) lands on
-        # layer_window
+        # the explicit Nones fill the block's kv_lengths/paged/lora slots
+        # so the per-layer scanned pytree (window array and/or adapter
+        # stacks) lands on the block's LAST positional argument. ``lora``
+        # splits into a broadcast context (slot_ids/scales, shared by all
+        # layers) and the per-layer stacks riding the scan axis.
+        windows = _layer_windows_array(cfg)
+        lora_ctx = lora.context() if lora is not None else None
+        scanned = None
+        if windows is not None or (lora is not None and lora.stacks is not None):
+            scanned = {}
+            if windows is not None:
+                scanned["window"] = windows
+            if lora is not None and lora.stacks is not None:
+                scanned["lora"] = lora.stacks
         x = _apply_layer_stack(
-            cfg, x, positions, mask, None, paged, decode=decode,
-            per_layer=_layer_windows_array(cfg),
+            cfg, x, positions, mask, None, paged, lora_ctx, decode=decode,
+            per_layer=scanned,
         )
         x = constrain_activations(RMSNorm(cfg, name="final_norm")(x))
         # logits matmul stays in the compute dtype (bf16 on the MXU — fp32
@@ -883,11 +989,12 @@ class SequenceClassifier(nn.Module):
                 # (B, S) keep-mask -> (B, 1, 1, S): padded keys invisible
                 attn_mask4d = attention_mask[:, None, None, :] > 0
         x = _make_embed(cfg, dtype)(input_ids)
-        # the explicit None fills the block's paged slot so the per-layer
-        # window array (if any) lands on layer_window
+        # the explicit Nones fill the block's paged/lora slots so the
+        # per-layer window dict (if any) lands on the scanned argument
+        windows = _layer_windows_array(cfg)
         x = _apply_layer_stack(
-            cfg, x, positions, attn_mask4d, kv_lengths, None,
-            per_layer=_layer_windows_array(cfg),
+            cfg, x, positions, attn_mask4d, kv_lengths, None, None,
+            per_layer={"window": windows} if windows is not None else None,
         )
         if is_prefix is not None:
             x = jnp.where(is_prefix[:, None, None], x, jnp.nan)

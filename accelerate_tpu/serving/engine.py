@@ -109,11 +109,18 @@ class ServingEngine:
         gauge_interval: int = 1,
         span_history: int = 512,
         max_retained_results: Optional[int] = 4096,
+        adapters: Any = None,
     ):
         self.model = model
         self.params = params
         self.max_slots = max_slots
         self.block_size = block_size
+        # multi-tenant serving: an AdapterRegistry whose fixed-shape
+        # stacks ride every prefill/decode call as traced data, indexed
+        # by a per-slot adapter row (the per-slot-temperatures idiom).
+        # Loading/evicting adapters rewrites stack ROWS — shapes never
+        # change, so the zero-retrace contract holds across tenant churn.
+        self.adapters = adapters
         cfg = model.config
         self._max_table = -(-cfg.max_seq_len // block_size)
         if num_blocks is None:
@@ -123,6 +130,10 @@ class ServingEngine:
         self.scheduler = ContinuousScheduler(
             max_slots, self.pool, now=now,
             max_queue=max_queue, max_queue_delay_s=max_queue_delay_s,
+            adapter_ready=(
+                (lambda a: adapters.resident(a)) if adapters is not None
+                else None
+            ),
         )
         self.sampling = SlotSampling(max_slots)
         self.stats = ServeStats()
@@ -138,6 +149,9 @@ class ServingEngine:
         self._now = now
         self._key = jax.random.PRNGKey(seed)
         self._tables = np.zeros((max_slots, self._max_table), np.int32)
+        # host mirror of each slot's adapter stack row (0 = base model),
+        # turned into a traced array per decode step — SlotSampling's idiom
+        self._slot_adapter = np.zeros(max_slots, np.int32)
         self._results: dict[str, list[int]] = {}
         self._result_order: collections.deque = collections.deque()
         self._shed_reasons: dict[str, str] = {}
@@ -162,7 +176,24 @@ class ServingEngine:
 
         traces = self._traces
 
-        def _prefill(params, cache, ids, table, length, key, temp):
+        def _lora_kwargs(lora_args):
+            """(stacks, scales, slot_ids) trailing args -> the model's
+            ``lora=`` kwarg. Empty when the engine has no registry — the
+            compiled programs are then byte-identical to the pre-adapter
+            engine."""
+            if not lora_args:
+                return {}
+            from ..adapters.runtime import LoraState
+
+            astacks, ascales, aslots = lora_args
+            return {
+                "lora": LoraState(
+                    stacks=astacks, slot_ids=aslots, scales=ascales
+                )
+            }
+
+        def _prefill(params, cache, ids, table, length, key, temp,
+                     *lora_args):
             traces["prefill"] += 1  # trace-time counter (not per call)
             state = PagedKVState(
                 block_table=table,
@@ -173,7 +204,7 @@ class ServingEngine:
             )
             logits, mutated = model.apply(
                 {"params": params, "cache": cache}, ids, decode=True,
-                paged=state, mutable=["cache"],
+                paged=state, mutable=["cache"], **_lora_kwargs(lora_args),
             )
             # last VALID row of the padded bucket, not the padded tail
             last = jnp.take_along_axis(
@@ -183,7 +214,7 @@ class ServingEngine:
             return mutated["cache"], token
 
         def _decode(params, cache, tokens, tables, cache_lens, lengths,
-                    temps, key):
+                    temps, key, *lora_args):
             traces["decode"] += 1  # zero-retrace contract rides on this
             state = PagedKVState(
                 block_table=tables,
@@ -194,7 +225,7 @@ class ServingEngine:
             )
             logits, mutated = model.apply(
                 {"params": params, "cache": cache}, tokens, decode=True,
-                paged=state, mutable=["cache"],
+                paged=state, mutable=["cache"], **_lora_kwargs(lora_args),
             )
             token = sample_tokens(
                 logits[:, -1], key, temps, top_k=top_k, top_p=top_p
@@ -214,20 +245,30 @@ class ServingEngine:
         temperature: float = 0.0,
         eos_token_id: Optional[int] = None,
         request_id: str = "",
+        adapter: Optional[str] = None,
     ) -> str:
         """Enqueue one request; returns its id. ``prompt`` is a token-id
         sequence. The request is admitted into a slot by a later
         :meth:`step` as soon as a seat AND its full block reservation are
-        available."""
+        available — and, when ``adapter`` names a tenant, once that
+        adapter is resident in the engine's registry."""
+        if adapter is not None and self.adapters is None:
+            raise ValueError(
+                f"request names adapter {adapter!r} but the engine was "
+                "built without an AdapterRegistry (pass adapters=...)"
+            )
         req = Request(
             prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
             max_new_tokens=max_new_tokens,
             temperature=temperature,
             eos_token_id=eos_token_id,
             request_id=request_id,
+            adapter=adapter,
         )
         rid = self.scheduler.submit(req)
-        self.span_log.on_submit(rid, req.submit_time, len(req.prompt))
+        self.span_log.on_submit(
+            rid, req.submit_time, len(req.prompt), adapter_id=adapter
+        )
         if req.shed_reason is not None:  # tail-dropped at the queue bound
             self._shed(req)
         return rid
@@ -271,6 +312,10 @@ class ServingEngine:
             if slot.busy and slot.done:
                 self._finish(slot)
         for slot in self.scheduler.admit():
+            if self.adapters is not None:
+                # pin the adapter for the request's whole flight — evict
+                # refuses while any seated request still decodes under it
+                self.adapters.acquire(slot.request.adapter)
             self.span_log.on_admit(slot.request.request_id, slot.admit_time)
             self._prefill_slot(slot, events)
         active = [s for s in self.scheduler.slots if s.busy and not s.done]
@@ -346,6 +391,18 @@ class ServingEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _lora_call_args(self, slot_ids) -> tuple:
+        """The (stacks, scales, slot_ids) tail every compiled call takes
+        when a registry is attached — pure traced DATA: residency churn
+        rewrites the stacks' rows, never their shapes."""
+        if self.adapters is None:
+            return ()
+        return (
+            self.adapters.stacks(),
+            self.adapters.scales(),
+            jnp.asarray(slot_ids, jnp.int32),
+        )
+
     def _prefill_slot(self, slot: Slot, events: list[TokenEvent]) -> None:
         req = slot.request
         self.span_log.on_prefill(req.request_id, self._now())
@@ -355,10 +412,13 @@ class ServingEngine:
         ids[0, :prompt_len] = req.prompt
         table = np.zeros((1, self._max_table), np.int32)
         table[0, :len(slot.blocks)] = slot.blocks
+        if self.adapters is not None:
+            self._slot_adapter[slot.index] = self.adapters.slot_of(req.adapter)
         self.cache, token = self._prefill_fn(
             self.params, self.cache, jnp.asarray(ids), jnp.asarray(table),
             jnp.asarray([prompt_len], jnp.int32), self._split_key(),
             jnp.asarray([req.temperature], jnp.float32),
+            *self._lora_call_args([self._slot_adapter[slot.index]]),
         )
         token = int(np.asarray(token)[0])
         slot.cache_len = prompt_len
@@ -383,6 +443,7 @@ class ServingEngine:
             jnp.asarray(self._tables), jnp.asarray(cache_lens),
             jnp.asarray(lengths), self.sampling.temperatures(),
             self._split_key(),
+            *self._lora_call_args(self._slot_adapter),
         )
         out = np.asarray(out)
         for slot in active:
@@ -410,6 +471,7 @@ class ServingEngine:
         decode_s = slot.finish_time - slot.first_token_time
         record = {
             "request_id": req.request_id,
+            "adapter_id": req.adapter,
             "prompt_tokens": len(req.prompt),
             "new_tokens": n_new,
             "queue_s": slot.admit_time - req.submit_time,
@@ -437,6 +499,9 @@ class ServingEngine:
                 self._results.pop(self._result_order.popleft(), None)
         self.sampling.clear_slot(slot.index)
         self._tables[slot.index] = 0
+        self._slot_adapter[slot.index] = 0
+        if self.adapters is not None:
+            self.adapters.release(req.adapter)
         self.scheduler.release(slot)
 
     def _shed(self, req: Request) -> None:
@@ -455,6 +520,7 @@ class ServingEngine:
         self._tele(
             "record_shed",
             request_id=req.request_id,
+            adapter_id=req.adapter,
             reason=reason,
             queue_s=now - req.submit_time,
             prompt_tokens=len(req.prompt),
@@ -494,6 +560,12 @@ class ServingEngine:
                 sched.blocked_reasons["no_free_slot"],
             "admission_blocked_pool_exhausted_total":
                 sched.blocked_reasons["pool_exhausted"],
+            "admission_blocked_adapter_not_resident_total":
+                sched.blocked_reasons["adapter_not_resident"],
+            "adapters_resident": (
+                len(self.adapters.resident_names())
+                if self.adapters is not None else 0
+            ),
             "shed_queue_full_total": sched.shed_counts["queue_full"],
             "shed_queue_deadline_total": sched.shed_counts["queue_deadline"],
         }

@@ -49,6 +49,10 @@ class Request:
     temperature: float = 0.0
     eos_token_id: Optional[int] = None
     request_id: str = ""
+    # multi-tenant serving: name of the adapter to decode under (None =
+    # the base model). Admission gates on the adapter being RESIDENT in
+    # the engine's AdapterRegistry.
+    adapter: Optional[str] = None
     submit_time: float = 0.0
     # set when the scheduler refuses/evicts the request instead of
     # queueing it: "queue_full" | "queue_deadline"
@@ -105,6 +109,7 @@ class ContinuousScheduler:
         now: Callable[[], float] = time.monotonic,
         max_queue: Optional[int] = None,
         max_queue_delay_s: Optional[float] = None,
+        adapter_ready: Optional[Callable[[Optional[str]], bool]] = None,
     ):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
@@ -118,8 +123,16 @@ class ContinuousScheduler:
         self._now = now
         self.max_queue = max_queue
         self.max_queue_delay_s = max_queue_delay_s
+        # multi-tenant gate: a request is only seated once its adapter is
+        # resident (prefilling against a not-yet-loaded adapter would
+        # silently decode under the identity row). None = no gating.
+        self.adapter_ready = adapter_ready
         self.shed_counts = {"queue_full": 0, "queue_deadline": 0}
-        self.blocked_reasons = {"no_free_slot": 0, "pool_exhausted": 0}
+        self.blocked_reasons = {
+            "no_free_slot": 0,
+            "pool_exhausted": 0,
+            "adapter_not_resident": 0,
+        }
         max_tokens = (pool.num_blocks - 1) * pool.block_size
         self.max_request_tokens = max_tokens
 
@@ -183,6 +196,15 @@ class ContinuousScheduler:
                 self.blocked_reasons["no_free_slot"] += 1
                 break
             req = self.queue[0]
+            if (
+                self.adapter_ready is not None
+                and not self.adapter_ready(req.adapter)
+            ):
+                # the head's adapter isn't resident yet — strict FIFO
+                # means later requests wait too (no tenant starvation by
+                # reordering; load the adapter to unblock)
+                self.blocked_reasons["adapter_not_resident"] += 1
+                break
             need = self.pool.blocks_for_tokens(
                 len(req.prompt) + req.max_new_tokens
             )

@@ -38,6 +38,9 @@ class RequestSpan:
     request_id: str
     submit_t: float
     prompt_tokens: int = 0
+    # multi-tenant serving: which adapter the request decodes under
+    # (None = the base model)
+    adapter_id: Optional[str] = None
     admit_t: Optional[float] = None
     prefill_start_t: Optional[float] = None
     first_token_t: Optional[float] = None
@@ -75,6 +78,7 @@ class RequestSpan:
             "request_id": self.request_id,
             "state": self.state,
             "shed_reason": self.shed_reason,
+            "adapter_id": self.adapter_id,
             "prompt_tokens": self.prompt_tokens,
             "new_tokens": self.new_tokens,
             "submit_t": self.submit_t,
@@ -113,13 +117,14 @@ class SpanLog:
     # lifecycle edges (the engine stamps these with its injectable clock)
     # ------------------------------------------------------------------ #
     def on_submit(
-        self, request_id: str, submit_t: float, prompt_tokens: int = 0
+        self, request_id: str, submit_t: float, prompt_tokens: int = 0,
+        adapter_id: Optional[str] = None,
     ) -> Optional[RequestSpan]:
         if not self.enabled:
             return None
         span = RequestSpan(
             request_id=request_id, submit_t=submit_t,
-            prompt_tokens=prompt_tokens,
+            prompt_tokens=prompt_tokens, adapter_id=adapter_id,
         )
         self._open[request_id] = span
         return span

@@ -372,6 +372,12 @@ class PrometheusTextSink(TelemetrySink):
 
     def _emit_serve(self, record: dict) -> None:
         label = str(record.get("label", "serve"))
+        # per-tenant request counter: every finished request increments
+        # {prefix}_serve_requests_total{adapter="<name>"} ("none" = the
+        # base model) — the multi-tenant traffic split at a glance
+        adapter = str(record.get("adapter_id") or "none")
+        ckey = (f"{self.prefix}_serve_requests_total", "adapter", adapter)
+        self._counters[ckey] = self._counters.get(ckey, 0.0) + 1.0
         for key, value in record.items():
             if isinstance(value, bool) or not isinstance(value, (int, float)):
                 continue

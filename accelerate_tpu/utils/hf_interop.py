@@ -899,3 +899,76 @@ def save_hf_checkpoint(
         hf_cfg["num_experts_per_tok"] = config.num_experts_per_tok
     with open(os.path.join(save_directory, "config.json"), "w") as f:
         json.dump(hf_cfg, f, indent=2, sort_keys=True)
+
+
+# ---------------------------------------------------------------------- #
+# PEFT adapter interop: native LoRA trees <-> PEFT key/layout conventions
+# ---------------------------------------------------------------------- #
+# PEFT (HF peft) names adapter tensors
+#   base_model.model.<module path>.lora_A.weight
+# where <module path> is the wrapped transformers module — for a Llama
+# CausalLM: model.layers.{i}.self_attn.q_proj (attention) or
+# model.layers.{i}.mlp.gate_proj (MLP). torch nn.Linear layout applies:
+# lora_A stores (r, in) and lora_B stores (out, r) — each the transpose
+# of the native flax (in, r)/(r, out) — and the leading layer axis of the
+# native scan-stacked leaves unstacks into per-layer keys.
+_PEFT_ATTN = ("q_proj", "k_proj", "v_proj", "o_proj")
+_PEFT_PREFIX = "base_model.model.model.layers"
+
+
+def _peft_module_path(layer: int, target: str) -> str:
+    group = "self_attn" if target in _PEFT_ATTN else "mlp"
+    return f"{_PEFT_PREFIX}.{layer}.{group}.{target}"
+
+
+def adapter_to_peft(
+    adapter_params: Any, lora_config, model_config
+) -> dict[str, np.ndarray]:
+    """Native adapter tree -> flat PEFT-named dict (torch layouts).
+
+    The result's keys/shapes are exactly what ``peft``'s
+    ``set_peft_model_state_dict`` expects for a Llama-family base model,
+    so a tree trained here exports into the HF adapter ecosystem the way
+    :func:`save_hf_checkpoint` exports base weights.
+    """
+    from ..adapters.runtime import A_KEY, B_KEY
+
+    L = model_config.num_layers
+    out: dict[str, np.ndarray] = {}
+    for target in lora_config.target_modules:
+        pair = adapter_params[target]
+        a = np.asarray(pair[A_KEY])  # (L, in, r)
+        b = np.asarray(pair[B_KEY])  # (L, r, out)
+        if a.shape[0] != L or b.shape[0] != L:
+            raise ValueError(
+                f"adapter leaf for {target!r} has layer dim "
+                f"{a.shape[0]}/{b.shape[0]}, model has {L} layers"
+            )
+        for i in range(L):
+            mod = _peft_module_path(i, target)
+            out[f"{mod}.lora_A.weight"] = np.ascontiguousarray(a[i].T)
+            out[f"{mod}.lora_B.weight"] = np.ascontiguousarray(b[i].T)
+    return out
+
+
+def peft_to_adapter(
+    state_dict: dict, lora_config, model_config
+) -> dict:
+    """Flat PEFT-named dict -> native adapter tree (the inverse of
+    :func:`adapter_to_peft`; re-stacks per-layer keys onto the leading
+    scan axis and transposes back to flax layouts)."""
+    from ..adapters.runtime import A_KEY, B_KEY
+
+    L = model_config.num_layers
+    adapter: dict = {}
+    for target in lora_config.target_modules:
+        a_slices, b_slices = [], []
+        for i in range(L):
+            mod = _peft_module_path(i, target)
+            a_slices.append(np.asarray(state_dict[f"{mod}.lora_A.weight"]).T)
+            b_slices.append(np.asarray(state_dict[f"{mod}.lora_B.weight"]).T)
+        adapter[target] = {
+            A_KEY: np.stack(a_slices),
+            B_KEY: np.stack(b_slices),
+        }
+    return adapter

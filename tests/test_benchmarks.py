@@ -249,7 +249,7 @@ def test_cpu_registry_groups_and_fast_subset():
     assert groups[0][0] == "dense"
     assert [v.name for v in groups[0][1]] == ["accum", "dense"]
     fast = reg.select(fast=True)
-    assert set(fast.names) == {"dense", "accum", "overhead", "ckpt"}
+    assert set(fast.names) == {"dense", "accum", "overhead", "ckpt", "lora"}
     assert fast.headline == "dense"
 
 

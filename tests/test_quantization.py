@@ -201,3 +201,74 @@ def test_load_and_quantize_hf_rejects_unconsumed(tmp_path):
         load_and_quantize_model(
             _abstract(config), path, qcfg, model_config=config, hf_format=True
         )
+
+
+@pytest.mark.parametrize("bits,tol", [(8, 0.01), (4, 0.12)])
+def test_dequant_matmul_matches_fp32_reference(bits, tol):
+    """The QLoRA compute contract: x @ dequantize(W) tracks the fp32
+    x @ W within the bit-width's quantization error, and the traced
+    (jitted) dequant-matmul is bitwise the eager one."""
+    rng = np.random.default_rng(bits)
+    w = jnp.asarray(rng.normal(size=(128, 64)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(8, 128)), jnp.float32)
+    q = quantize_tensor(w, bits=bits, block_size=32)
+    ref = x @ w
+    out = x @ q.dequantize(jnp.float32)
+    rel = float(jnp.linalg.norm(out - ref) / jnp.linalg.norm(ref))
+    assert rel < tol, rel
+    jitted = jax.jit(lambda qt, a: a @ qt.dequantize(jnp.float32))(q, x)
+    assert np.array_equal(np.asarray(jitted), np.asarray(out))
+
+
+def test_gradients_identically_zero_through_frozen_quantized_base():
+    """QLoRA's frozen-base contract: d(loss)/d(base) is BITWISE zero —
+    the base sits behind stop_gradient inside lora_loss_fn, so even the
+    float leaves of the quantized tree (the scales) take exactly-zero
+    gradients, while the adapter's gradients flow."""
+    from accelerate_tpu.adapters import LoraConfig, init_adapter, lora_loss_fn
+
+    cfg = TransformerConfig.tiny()
+    model = CausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32,
+    )
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    qbase = quantize_params(
+        params, QuantizationConfig(load_in_8bit=True, min_weight_size=256)
+    )
+    lcfg = LoraConfig(rank=4, target_modules=("q_proj", "v_proj"))
+    adapter = init_adapter(jax.random.PRNGKey(1), cfg, lcfg)
+    # give B mass so adapter grads flow through BOTH a and b
+    adapter = jax.tree.map(lambda l: l + 0.01, adapter)
+    batch = {"input_ids": ids}
+
+    def rebuild(scale_leaf, leaf):
+        if is_quantized(leaf):
+            return QuantizedTensor(
+                leaf.codes, scale_leaf, leaf.bits, leaf.shape, leaf.block_size
+            )
+        return scale_leaf
+
+    # differentiate w.r.t. every FLOAT leaf of the quantized base (scales
+    # + unquantized smalls) — int codes are not differentiable by
+    # construction, which is itself half the frozen-base story
+    float_tree = jax.tree.map(
+        lambda l: l.scales if is_quantized(l) else l, qbase,
+        is_leaf=is_quantized,
+    )
+
+    def loss_of_base(ft):
+        qb = jax.tree.map(rebuild, ft, qbase, is_leaf=is_quantized)
+        return lora_loss_fn(model, qb, lcfg, compute_dtype=jnp.float32)(
+            adapter, batch
+        )
+
+    base_grads = jax.grad(loss_of_base)(float_tree)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(base_grads)[0]:
+        assert not np.any(np.asarray(leaf)), path
+
+    ad_grads = jax.grad(
+        lora_loss_fn(model, qbase, lcfg, compute_dtype=jnp.float32)
+    )(adapter, batch)
+    assert all(np.any(np.asarray(l)) for l in jax.tree.leaves(ad_grads))
