@@ -32,11 +32,12 @@ FAST_FILES = \
   tests/test_diagnostics.py tests/test_benchmarks.py \
   tests/test_serving.py tests/test_serving_obs.py \
   tests/test_elastic.py tests/test_fused_kernels.py \
-  tests/test_slice_mesh.py tests/test_adapters.py
+  tests/test_slice_mesh.py tests/test_adapters.py \
+  tests/test_prefix_cache.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
   diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke \
-  slice-smoke kernels-smoke lora-smoke
+  slice-smoke kernels-smoke lora-smoke prefix-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -142,6 +143,17 @@ kernels-smoke:
 	  tests/test_fused_kernels.py::test_epilogue_kernel_bitwise_vs_reference \
 	  tests/test_fused_kernels.py::test_zero_retraces_after_warmup_with_fused_kernels
 	python bench.py dense
+
+# prefix-caching acceptance on CPU (~30s): two requests sharing a long
+# template — the second skips prefill for every shared full block and
+# decodes bitwise-equal to a cold-cache control; a divergent third
+# request exercises copy-on-write and still matches its control, all
+# with zero decode retraces. The tenant-isolation test (tenant A's
+# cached prefix must never serve tenant B) rides along as preflight.
+prefix-smoke:
+	JAX_PLATFORMS=cpu $(PYTEST) -q \
+	  tests/test_prefix_cache.py::test_tenant_a_cached_prefix_never_serves_tenant_b \
+	  tests/test_prefix_cache.py::test_prefix_smoke_end_to_end
 
 # multi-tenant adapter acceptance on CPU (~30s): train a LoRA adapter
 # through unified_step (adapter-only carry), commit its checkpoint

@@ -153,11 +153,12 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
             # generate; NOT in --fast (it compiles every prefill bucket
             # plus two decode paths — too heavy for the 120s deadline).
             # args: (cfg, max_slots, block_size, n_requests, seed)
-            # estimate covers the headline engine+baseline passes plus
-            # the observability-overhead A/B rounds (4 extra trace
-            # replays on the warm engine)
+            # estimate covers the headline engine+baseline passes, the
+            # observability-overhead A/B rounds (4 extra trace replays
+            # on the warm engine), and the prefix-caching cold/warm A/B
+            # on the templated cohort (2 warmup + 2 timed passes)
             _variant("serve", "serve", 3, "serve", (tiny, 4, 8, 16, 0),
-                     default_estimate_s=110),
+                     default_estimate_s=150),
             _variant("ckpt", "ckpt", 3, "ckpt", (tiny, 4, 64, 8, 2),
                      fast=True, default_estimate_s=15),
             # adapter-only vs full fine-tune economics + the multi-tenant
@@ -288,7 +289,7 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
         # process and resident weights-compile budget); args:
         # (cfg, max_slots, block_size, n_requests, seed)
         _variant("serve", "serve", 3, "decode", (decode, 4, 16, 8, 0),
-                 default_estimate_s=1500),
+                 default_estimate_s=1700),
         _variant("moe", "train", 3, "moe", (moe, 16, 1024, 20, 3),
                  default_estimate_s=600),
         _variant("longseq", "train", 3, "longseq", (longseq, 1, 8192, 8, 2),

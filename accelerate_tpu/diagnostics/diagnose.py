@@ -212,6 +212,8 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
                 "engine_steps", "queue_depth", "queue_age_p95_s",
                 "slots_active", "slot_occupancy", "pool_utilization",
                 "tokens_in_flight",
+                "prefix_cache_hit_rate", "shared_blocks",
+                "cow_copies_total", "prefill_tokens_saved_total",
                 "admission_blocked_no_free_slot_total",
                 "admission_blocked_pool_exhausted_total",
                 "shed_queue_full_total", "shed_queue_deadline_total",
@@ -366,6 +368,15 @@ def format_report(report: dict) -> str:
                 lines.append(
                     f"    admission blocked: no_free_slot={blocked_slot or 0} "
                     f"pool_exhausted={blocked_pool or 0}"
+                )
+            hit_rate = s.get("prefix_cache_hit_rate")
+            saved = s.get("prefill_tokens_saved_total")
+            if hit_rate or saved or s.get("cow_copies_total"):
+                lines.append(
+                    f"    prefix cache: hit_rate={hit_rate or 0.0:.1%} "
+                    f"shared_blocks={s.get('shared_blocks') or 0} "
+                    f"cow_copies={s.get('cow_copies_total') or 0} "
+                    f"prefill_tokens_saved={saved or 0}"
                 )
             if s.get("slo_target") is not None:
                 ttft = s.get("slo_ttft_attainment")

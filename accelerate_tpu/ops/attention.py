@@ -74,6 +74,12 @@ def paged_update(
     rerouted to reserved block 0 — real blocks are never handed out as 0,
     so garbage can never collide with live data. Static shapes: one
     compiled scatter regardless of how full any sequence is.
+
+    Because every write lands at ``cache_len + i``, a nonzero
+    ``cache_len`` makes the SAME program a tail prefill: prefix caching
+    passes the cached-token count as ``cache_len`` and only the uncached
+    tail as ``k``/``v`` — the shared prefix blocks in ``block_table`` are
+    read by attention but never written.
     """
     b, s = k.shape[:2]
     bs = state.block_size

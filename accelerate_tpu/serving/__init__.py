@@ -11,7 +11,7 @@ telemetry riding the existing sink stack as ``kind="serve"`` records.
 """
 
 from ..ops.attention import PagedKVState, paged_attention, paged_update
-from .block_pool import BlockPool
+from .block_pool import BlockPool, PrefixCache, prefix_keys
 from .engine import ServingEngine, TokenEvent
 from .sampling import SlotSampling, sample_tokens
 from .scheduler import ContinuousScheduler, Request, Slot
@@ -28,6 +28,7 @@ __all__ = [
     "BlockPool",
     "ContinuousScheduler",
     "PagedKVState",
+    "PrefixCache",
     "Request",
     "RequestSpan",
     "SLOConfig",
@@ -41,6 +42,7 @@ __all__ = [
     "paged_attention",
     "paged_update",
     "percentile",
+    "prefix_keys",
     "sample_tokens",
     "spans_to_chrome_trace",
     "write_chrome_trace",

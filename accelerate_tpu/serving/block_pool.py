@@ -6,19 +6,59 @@ the host, where a free list costs nanoseconds instead of a recompile.
 Block 0 is reserved as the garbage block: the device routes every
 invalid write (bucket padding, inactive decode slots) there, so the
 allocator must never hand it out.
+
+Prefix caching (vLLM's PagedAttention sharing, SGLang's RadixAttention
+in chain form) turns the pool into a refcounted, content-addressed KV
+store:
+
+* every block carries a REFCOUNT; ``allocate`` acquires (refcount 1),
+  ``free`` releases, and a block only leaves a request's hands at
+  refcount 0 — two requests sharing a system-prompt block each hold a
+  reference, and neither can pull the block out from under the other;
+* a FULL prompt block can be PUBLISHED under a content key (a rolling
+  hash over the model fingerprint, the adapter id, and the token ids of
+  this block AND every block before it — see :class:`PrefixCache`), so
+  a later request with the same prefix finds the whole chain with one
+  dict walk;
+* a published block whose refcount drops to 0 is not returned to the
+  free list: it RETIRES into an LRU of cached blocks, still indexed, so
+  the next request with that prefix skips prefill entirely. Allocation
+  pressure evicts from the LRU cold-end first (refcount-0 blocks ONLY —
+  a hot cache can delay nothing and never blocks admission).
+
+Shared blocks are immutable by contract: writers copy-on-write (the
+ENGINE does the device-side copy; the pool only swaps the bookkeeping),
+so cached output stays bitwise identical to a cold run.
 """
 
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
 
 class BlockPool:
-    """Free-list over ``num_blocks`` KV blocks of ``block_size`` tokens.
+    """Refcounted free-list over ``num_blocks`` KV blocks of
+    ``block_size`` tokens, with a content-hash index for prefix reuse.
 
     Allocation is all-or-nothing per request (the scheduler reserves a
     request's FULL worst-case footprint at admission — see
-    ``ContinuousScheduler.admit``), frees return blocks for immediate
-    reuse, and double-free / foreign-block frees raise instead of
-    corrupting a neighbour's cache.
+    ``ContinuousScheduler.admit``), frees release references (a block
+    returns for reuse only at refcount 0), and double-free /
+    foreign-block frees raise instead of corrupting a neighbour's cache.
+
+    Block states (disjoint; ``num_free + num_allocated + num_cached ==
+    num_blocks - 1`` always — the garbage block is in none of them):
+
+    * FREE       — on the free list, contents meaningless;
+    * ALLOCATED  — refcount >= 1 holder(s); possibly content-indexed
+                   (published), possibly shared (refcount >= 2);
+    * CACHED     — refcount 0 but content-indexed: parked in the LRU,
+                   reusable via :meth:`lookup`/:meth:`acquire`, evicted
+                   (index entry dropped) under allocation pressure.
     """
 
     def __init__(self, num_blocks: int, block_size: int):
@@ -32,15 +72,38 @@ class BlockPool:
         self.num_blocks = num_blocks
         self.block_size = block_size
         self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> lowest id
-        self._allocated: set[int] = set()
+        self._ref: dict[int, int] = {}        # block -> refcount (>= 1)
+        self._hash_of: dict[int, bytes] = {}  # published block -> content key
+        self._index: dict[bytes, int] = {}    # content key -> block
+        # refcount-0 published blocks, insertion order = recency (oldest
+        # first — popitem(last=False) is the eviction end)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self.evictions_total = 0
 
+    # ------------------------------------------------------------------ #
+    # occupancy
+    # ------------------------------------------------------------------ #
     @property
     def num_free(self) -> int:
         return len(self._free)
 
     @property
     def num_allocated(self) -> int:
-        return len(self._allocated)
+        return len(self._ref)
+
+    @property
+    def num_cached(self) -> int:
+        """Refcount-0 published blocks parked in the LRU (reusable AND
+        evictable)."""
+        return len(self._lru)
+
+    @property
+    def num_shared(self) -> int:
+        """Allocated blocks currently held by >= 2 requests."""
+        return sum(1 for n in self._ref.values() if n >= 2)
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
 
     def blocks_for_tokens(self, tokens: int) -> int:
         """ceil(tokens / block_size) — the sizing formula. A request
@@ -48,29 +111,137 @@ class BlockPool:
         return -(-max(tokens, 0) // self.block_size)
 
     def can_allocate(self, n: int) -> bool:
-        return n <= len(self._free)
+        """Cached refcount-0 blocks count as capacity: they are evicted
+        on demand, so a hot prefix cache never blocks admission."""
+        return n <= len(self._free) + len(self._lru)
 
+    # ------------------------------------------------------------------ #
+    # acquire / release
+    # ------------------------------------------------------------------ #
     def allocate(self, n: int) -> list[int]:
-        """Take ``n`` blocks or raise — the caller must gate on
-        :meth:`can_allocate` (the scheduler's admission check)."""
-        if n > len(self._free):
+        """Take ``n`` private blocks (refcount 1) or raise — the caller
+        must gate on :meth:`can_allocate` (the scheduler's admission
+        check). Empties the free list first, then evicts cached
+        refcount-0 blocks LRU-first (their index entries drop — the
+        prefix they cached must be re-prefilled by its next user)."""
+        if not self.can_allocate(n):
             raise RuntimeError(
                 f"block pool exhausted: need {n}, have {len(self._free)} "
-                f"free of {self.num_blocks - 1} allocatable"
+                f"free + {len(self._lru)} evictable cached of "
+                f"{self.num_blocks - 1} allocatable"
             )
-        blocks = [self._free.pop() for _ in range(n)]
-        self._allocated.update(blocks)
+        blocks = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:
+                b = self._evict_lru()
+            self._ref[b] = 1
+            blocks.append(b)
         return blocks
 
-    def free(self, blocks: list[int]) -> None:
+    def _evict_lru(self) -> int:
+        """Drop the coldest cached block's index entry and repurpose the
+        block. Only refcount-0 blocks live in the LRU, so a shared or
+        in-flight block can never be evicted."""
+        block, _ = self._lru.popitem(last=False)
+        key = self._hash_of.pop(block)
+        del self._index[key]
+        self.evictions_total += 1
+        return block
+
+    def free(self, blocks: Iterable[int]) -> None:
+        """Release one reference per block. At refcount 0 an unpublished
+        block returns to the free list; a published one retires into the
+        cached LRU (most-recently-used end) still indexed for reuse."""
         for b in blocks:
-            if b not in self._allocated:
+            if b not in self._ref:
                 raise ValueError(
                     f"freeing block {b} that is not allocated (double free "
                     f"or foreign block)"
                 )
-            self._allocated.remove(b)
-            self._free.append(b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                if b in self._hash_of:
+                    self._lru[b] = None  # retire hot: MRU end
+                else:
+                    self._free.append(b)
+
+    def acquire(self, blocks: Sequence[int]) -> None:
+        """Take one reference per block on already-live or cached blocks
+        — the warm-hit path. A cached (refcount-0) block leaves the LRU;
+        an in-flight block (its publisher still decoding) just gains a
+        reference. Raises on blocks that are neither (freed/evicted —
+        the caller's :meth:`lookup` result went stale)."""
+        taken: list[int] = []
+        try:
+            for b in blocks:
+                if b in self._ref:
+                    self._ref[b] += 1
+                elif b in self._lru:
+                    del self._lru[b]
+                    self._ref[b] = 1
+                else:
+                    raise ValueError(
+                        f"acquiring block {b} that is neither allocated nor "
+                        f"cached (stale lookup?)"
+                    )
+                taken.append(b)
+        except ValueError:
+            self.free(taken)  # all-or-nothing: roll back partial chains
+            raise
+
+    # ------------------------------------------------------------------ #
+    # content index
+    # ------------------------------------------------------------------ #
+    def publish(self, block: int, key: bytes) -> int:
+        """Content-index an allocated block under ``key`` and return the
+        CANONICAL block for that key. If another block already owns the
+        key (two identical prompts prefilled concurrently), the existing
+        entry wins and the caller's block stays private — first writer
+        wins keeps the index one-to-one."""
+        if block not in self._ref:
+            raise ValueError(
+                f"publishing block {block} that is not allocated"
+            )
+        existing = self._index.get(key)
+        if existing is not None and existing != block:
+            return existing
+        self._index[key] = block
+        self._hash_of[block] = key
+        return block
+
+    def lookup(self, keys: Sequence[bytes]) -> list[int]:
+        """Longest indexed chain-prefix of ``keys`` — the blocks, in
+        chain order, WITHOUT acquiring them (call :meth:`acquire` before
+        any allocation can evict them). Keys are rolling hashes, so a
+        match at position i implies every token up to block i matched;
+        the walk stops at the first miss."""
+        out: list[int] = []
+        for k in keys:
+            b = self._index.get(k)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def unpublish(self, block: int) -> None:
+        """Drop a block's index entry (COW bookkeeping / cache clear).
+        No-op for unpublished blocks; a cached block becomes plain free."""
+        key = self._hash_of.pop(block, None)
+        if key is not None and self._index.get(key) == block:
+            del self._index[key]
+        if block in self._lru:
+            del self._lru[block]
+            self._free.append(block)
+
+    def clear_cache(self) -> None:
+        """Forget every cached prefix: LRU blocks return to the free
+        list, in-flight published blocks lose their index entries (they
+        stay allocated to their holders). The A/B toggle's OFF edge."""
+        for block in list(self._hash_of):
+            self.unpublish(block)
 
     def stats(self) -> dict:
         """Occupancy snapshot; ``utilization`` counts only allocatable
@@ -80,6 +251,134 @@ class BlockPool:
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
             "free": len(self._free),
-            "allocated": len(self._allocated),
-            "utilization": len(self._allocated) / usable if usable else 0.0,
+            "allocated": len(self._ref),
+            "cached": len(self._lru),
+            "shared": self.num_shared,
+            "evictions_total": self.evictions_total,
+            "utilization": len(self._ref) / usable if usable else 0.0,
+        }
+
+
+# ---------------------------------------------------------------------- #
+# prefix cache keying + accounting
+# ---------------------------------------------------------------------- #
+def prefix_keys(
+    fingerprint: str,
+    adapter_id: Optional[str],
+    tokens: Sequence[int],
+    block_size: int,
+) -> list[bytes]:
+    """Rolling content keys for every FULL block of ``tokens``.
+
+    ``key[i] = H(key[i-1] || tokens of block i)`` seeded with
+    ``H(fingerprint, adapter_id)`` — so a key commits to the model, the
+    tenant, AND the whole token prefix up to its block. Two tenants with
+    identical prompts get disjoint keys (a PR 12 adapter changes the QKV
+    projections, so their KV must never be shared), and a block's key
+    can be computed without ever comparing token lists.
+    """
+    h = hashlib.sha256(
+        b"accelerate_tpu.prefix\x00"
+        + fingerprint.encode()
+        + b"\x00"
+        + (adapter_id or "\x00base").encode()
+    ).digest()
+    n_full = len(tokens) // block_size
+    # fixed-width little-endian token bytes: unambiguous (no separator
+    # games) and ~4x faster to produce than str-join — this runs on the
+    # admission hot path for every request
+    raw = memoryview(
+        np.asarray(tokens[:n_full * block_size], dtype=np.int64).tobytes()
+    )
+    keys: list[bytes] = []
+    for i in range(n_full):
+        h = hashlib.sha256(
+            h + raw[i * block_size * 8:(i + 1) * block_size * 8]
+        ).digest()
+        keys.append(h)
+    return keys
+
+
+class PrefixCache:
+    """Prefix lookup/publish policy + hit accounting over a
+    :class:`BlockPool`'s content index.
+
+    Pure host-side scheduler state: matching, refcounting and COW
+    decisions all happen here and in the engine's admission path — the
+    compiled prefill/decode programs never change, which is what keeps
+    zero-retrace-after-warmup an asserted contract with caching on.
+    """
+
+    def __init__(self, pool: BlockPool, fingerprint: str = ""):
+        self.pool = pool
+        self.fingerprint = fingerprint
+        self.lookups = 0
+        self.hits = 0
+        self.hit_blocks_total = 0
+        self.tokens_saved_total = 0
+        self.cow_copies_total = 0
+
+    def keys_for(
+        self, tokens: Sequence[int], adapter_id: Optional[str]
+    ) -> list[bytes]:
+        return prefix_keys(
+            self.fingerprint, adapter_id, tokens, self.pool.block_size
+        )
+
+    def match(
+        self,
+        tokens: Sequence[int],
+        adapter_id: Optional[str] = None,
+        keys: Optional[Sequence[bytes]] = None,
+    ) -> list[int]:
+        """Longest cached block-chain prefix of ``tokens`` (block ids in
+        chain order; empty on a miss). Counts the lookup either way.
+        ``keys``: precomputed :meth:`keys_for` result — admission
+        computes a request's keys ONCE and reuses them at publish."""
+        self.lookups += 1
+        if keys is None:
+            keys = self.keys_for(tokens, adapter_id)
+        blocks = self.pool.lookup(keys)
+        if blocks:
+            self.hits += 1
+            self.hit_blocks_total += len(blocks)
+        return blocks
+
+    def publish(
+        self,
+        tokens: Sequence[int],
+        adapter_id: Optional[str],
+        blocks: Sequence[int],
+        skip_indices: Iterable[int] = (),
+        keys: Optional[Sequence[bytes]] = None,
+    ) -> int:
+        """Index every FULL prompt block of a freshly prefilled request.
+        ``blocks`` is the slot's block table in chain order;
+        ``skip_indices`` are table positions that must stay out of the
+        index (already-shared canonical blocks, COW copies whose content
+        was partially recomputed). Returns how many blocks were newly
+        published."""
+        skip = set(skip_indices)
+        published = 0
+        if keys is None:
+            keys = self.keys_for(tokens, adapter_id)
+        for t, key in enumerate(keys):
+            if t in skip:
+                continue
+            if self.pool.publish(blocks[t], key) == blocks[t]:
+                published += 1
+        return published
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def stats(self) -> dict:
+        return {
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "hit_rate": self.hit_rate,
+            "hit_blocks_total": self.hit_blocks_total,
+            "prefill_tokens_saved_total": self.tokens_saved_total,
+            "cow_copies_total": self.cow_copies_total,
         }

@@ -23,6 +23,7 @@ Zero-retrace is an explicit contract: trace-time counters
 from __future__ import annotations
 
 import collections
+import hashlib
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Iterator, Optional
@@ -32,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import PagedKVState
-from .block_pool import BlockPool
+from .block_pool import BlockPool, PrefixCache
 from .sampling import SlotSampling, sample_tokens
 from .scheduler import ContinuousScheduler, Request, Slot
 from .slo import SLOConfig, SloTracker
@@ -110,6 +111,8 @@ class ServingEngine:
         span_history: int = 512,
         max_retained_results: Optional[int] = 4096,
         adapters: Any = None,
+        prefix_cache: bool = False,
+        model_fingerprint: Optional[str] = None,
     ):
         self.model = model
         self.params = params
@@ -127,6 +130,18 @@ class ServingEngine:
             num_blocks = max_slots * self._max_table + 1
         self.num_blocks = num_blocks
         self.pool = BlockPool(num_blocks, block_size)
+        # prefix caching (vLLM-style shared KV): pure host-side policy —
+        # the SAME compiled programs serve cold and warm requests, warm
+        # ones just prefill a shorter tail at a true cache offset.
+        # Default OFF: outputs are identical either way (only TTFT and
+        # HBM footprint change), but sharing is an explicit opt-in.
+        self._model_fingerprint = model_fingerprint or hashlib.sha256(
+            repr(cfg).encode()
+        ).hexdigest()[:16]
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.pool, fingerprint=self._model_fingerprint)
+            if prefix_cache else None
+        )
         self.scheduler = ContinuousScheduler(
             max_slots, self.pool, now=now,
             max_queue=max_queue, max_queue_delay_s=max_queue_delay_s,
@@ -134,6 +149,7 @@ class ServingEngine:
                 (lambda a: adapters.resident(a)) if adapters is not None
                 else None
             ),
+            prefix_cache=self.prefix_cache,
         )
         self.sampling = SlotSampling(max_slots)
         self.stats = ServeStats()
@@ -158,7 +174,7 @@ class ServingEngine:
         self._shed_order: collections.deque = collections.deque()
         self._steps = 0
         self._http: Any = None
-        self._traces = {"prefill": 0, "decode": 0}
+        self._traces = {"prefill": 0, "decode": 0, "cow": 0}
 
         from ..models.generation import init_cache
 
@@ -192,12 +208,19 @@ class ServingEngine:
                 )
             }
 
-        def _prefill(params, cache, ids, table, length, key, temp,
-                     *lora_args):
+        def _prefill(params, cache, ids, table, length, cached_len, key,
+                     temp, *lora_args):
             traces["prefill"] += 1  # trace-time counter (not per call)
+            # cached_len > 0 is the warm-hit path: ``ids`` holds only the
+            # UNCACHED tail and the paged cache already contains KV for
+            # the first cached_len positions (shared prefix blocks in
+            # ``table``) — writes land at cached_len + i and attention
+            # sees cols <= cached_len + i, exactly a mid-sequence
+            # continuation. cached_len == 0 is the cold path, and both
+            # run the SAME compiled program (cached_len is traced data).
             state = PagedKVState(
                 block_table=table,
-                cache_len=jnp.zeros((1,), jnp.int32),
+                cache_len=cached_len,
                 lengths=length,
                 num_blocks=num_blocks,
                 block_size=block_size,
@@ -232,8 +255,39 @@ class ServingEngine:
             )
             return mutated["cache"], token
 
+        def _key_chain(key):
+            # 16 sequential (key, sub) = split(key) steps in ONE compiled
+            # call: the subkey STREAM is bit-identical to calling
+            # jax.random.split 16 times, but the per-step dispatch (~65us
+            # on CPU — real money on the warm-prefill TTFT path) is paid
+            # once per 16 prefill/decode calls instead of every call.
+            def body(k, _):
+                k2, sub = jax.random.split(k)
+                return k2, sub
+            return jax.lax.scan(body, key, None, length=16)
+
+        def _cow(cache, src, dst):
+            traces["cow"] += 1  # one compiled program, reused per copy
+            # Copy one block row in every per-layer K/V pool. Pools are
+            # nn.scan-stacked: leaves shaped (L, num_blocks, block_size,
+            # kv_heads, head_dim) — match on the (num_blocks, block_size)
+            # axes rather than names so non-pool cache leaves pass through.
+            def copy(leaf):
+                if (
+                    leaf.ndim >= 4
+                    and leaf.shape[-4] == num_blocks
+                    and leaf.shape[-3] == block_size
+                ):
+                    lead = (slice(None),) * (leaf.ndim - 4)
+                    return leaf.at[lead + (dst,)].set(leaf[lead + (src,)])
+                return leaf
+            return jax.tree.map(copy, cache)
+
         self._prefill_fn = jax.jit(_prefill)
         self._decode_fn = jax.jit(_decode)
+        self._cow_fn = jax.jit(_cow)
+        self._key_chain_fn = jax.jit(_key_chain)
+        self._key_buf: collections.deque = collections.deque()
 
     # ------------------------------------------------------------------ #
     # request API
@@ -388,8 +442,10 @@ class ServingEngine:
     # internals
     # ------------------------------------------------------------------ #
     def _split_key(self) -> jax.Array:
-        self._key, sub = jax.random.split(self._key)
-        return sub
+        if not self._key_buf:
+            self._key, subs = self._key_chain_fn(self._key)
+            self._key_buf.extend(np.asarray(subs))
+        return jnp.asarray(self._key_buf.popleft())
 
     def _lora_call_args(self, slot_ids) -> tuple:
         """The (stacks, scales, slot_ids) tail every compiled call takes
@@ -403,20 +459,67 @@ class ServingEngine:
             jnp.asarray(slot_ids, jnp.int32),
         )
 
+    def _cow_block(self, slot: Slot, tindex: int) -> None:
+        """Copy-on-write table position ``tindex`` of ``slot``: allocate
+        a private block (the admission-reserved spare first), one
+        device-side block copy, swap the table entry, drop the shared
+        reference. The donor block — and every other holder's view of it
+        — is untouched; the COW copy stays OUT of the content index (its
+        tail will be re-written at a different bucket width, so its
+        content is not canonical for the chain key)."""
+        donor = slot.blocks[tindex]
+        if slot.cow_spare is not None:
+            private = slot.cow_spare
+            slot.cow_spare = None
+        else:
+            private = self.pool.allocate(1)[0]
+        self.cache = self._cow_fn(
+            self.cache,
+            jnp.asarray(donor, jnp.int32),
+            jnp.asarray(private, jnp.int32),
+        )
+        slot.blocks[tindex] = private
+        self.pool.free([donor])
+        slot.shared.discard(tindex)
+        slot.cow_indices.add(tindex)
+        self._tables[slot.index, tindex] = private
+        if self.prefix_cache is not None:
+            self.prefix_cache.cow_copies_total += 1
+
     def _prefill_slot(self, slot: Slot, events: list[TokenEvent]) -> None:
         req = slot.request
-        self.span_log.on_prefill(req.request_id, self._now())
         prompt_len = len(req.prompt)
-        bucket = _next_pow2(prompt_len)
+        # prefix-cache hit: the first ``cached`` prompt tokens' KV is
+        # already in the shared blocks the scheduler pointed our table
+        # at — prefill covers only the tail (always >= 1 token: the last
+        # prompt position's logits seed sampling).
+        cached = slot.cached_tokens
+        self.span_log.on_prefill(
+            req.request_id, self._now(), cached_prefix_tokens=cached
+        )
+        if cached and self.prefix_cache is not None:
+            self.prefix_cache.tokens_saved_total += cached
+        # COW any SHARED block the tail prefill will write into. With
+        # block-aligned hits the tail starts on a private block, so this
+        # loop only fires on a full-prompt hit (cached == prompt_len-1):
+        # the 1-token tail re-writes the last shared block's final slot.
+        for t in range(cached // self.block_size,
+                       (prompt_len - 1) // self.block_size + 1):
+            if t in slot.shared:
+                self._cow_block(slot, t)
+        tail = req.prompt[cached:]
+        tail_len = prompt_len - cached
+        bucket = _next_pow2(tail_len)
         ids = np.zeros((1, bucket), np.int32)
-        ids[0, :prompt_len] = req.prompt
+        ids[0, :tail_len] = tail
         table = np.zeros((1, self._max_table), np.int32)
         table[0, :len(slot.blocks)] = slot.blocks
         if self.adapters is not None:
             self._slot_adapter[slot.index] = self.adapters.slot_of(req.adapter)
         self.cache, token = self._prefill_fn(
             self.params, self.cache, jnp.asarray(ids), jnp.asarray(table),
-            jnp.asarray([prompt_len], jnp.int32), self._split_key(),
+            jnp.asarray([tail_len], jnp.int32),
+            jnp.asarray([cached], jnp.int32), self._split_key(),
             jnp.asarray([req.temperature], jnp.float32),
             *self._lora_call_args([self._slot_adapter[slot.index]]),
         )
@@ -424,6 +527,15 @@ class ServingEngine:
         slot.cache_len = prompt_len
         slot.pending = token
         slot.generated = [token]
+        # index every FULL prompt block we freshly prefilled so the next
+        # identical prefix skips it. Shared positions are already
+        # canonical; COW copies stay out (partially recomputed content).
+        if self.prefix_cache is not None:
+            self.prefix_cache.publish(
+                req.prompt, req.adapter, slot.blocks,
+                skip_indices=slot.shared | slot.cow_indices,
+                keys=req.prefix_keys,
+            )
         slot.first_token_time = self._now()
         self.span_log.on_first_token(req.request_id, slot.first_token_time)
         self._tables[slot.index] = table[0]
@@ -435,6 +547,13 @@ class ServingEngine:
         cache_lens = np.zeros(self.max_slots, np.int32)
         lengths = np.zeros(self.max_slots, np.int32)
         for slot in active:
+            # shared blocks are immutable: a decode step about to write
+            # into one (the pending token lands at cache_len) copies it
+            # private first. Block-aligned hits mean this only fires when
+            # generation flows into a still-shared block boundary case.
+            t = slot.cache_len // self.block_size
+            if t in slot.shared:
+                self._cow_block(slot, t)
             tokens[slot.index, 0] = slot.pending
             cache_lens[slot.index] = slot.cache_len
             lengths[slot.index] = 1
@@ -473,6 +592,7 @@ class ServingEngine:
             "request_id": req.request_id,
             "adapter_id": req.adapter,
             "prompt_tokens": len(req.prompt),
+            "cached_prefix_tokens": slot.cached_tokens,
             "new_tokens": n_new,
             "queue_s": slot.admit_time - req.submit_time,
             "ttft_s": slot.first_token_time - req.submit_time,
@@ -554,7 +674,21 @@ class ServingEngine:
             "slot_occupancy": len(active) / self.max_slots,
             "pool_blocks_free": pool["free"],
             "pool_blocks_allocated": pool["allocated"],
+            "pool_blocks_cached": pool["cached"],
             "pool_utilization": pool["utilization"],
+            "shared_blocks": pool["shared"],
+            "prefix_cache_hit_rate": (
+                self.prefix_cache.hit_rate
+                if self.prefix_cache is not None else 0.0
+            ),
+            "cow_copies_total": (
+                self.prefix_cache.cow_copies_total
+                if self.prefix_cache is not None else 0
+            ),
+            "prefill_tokens_saved_total": (
+                self.prefix_cache.tokens_saved_total
+                if self.prefix_cache is not None else 0
+            ),
             "tokens_in_flight": sum(s.cache_len for s in active),
             "admission_blocked_no_free_slot_total":
                 sched.blocked_reasons["no_free_slot"],
@@ -605,6 +739,28 @@ class ServingEngine:
         else:
             self.slo_tracker = SloTracker(slo)
         self.span_log.enabled = spans
+
+    def set_prefix_cache(
+        self, enabled: bool, model_fingerprint: Optional[str] = None
+    ) -> None:
+        """Toggle prefix caching at runtime on a WARM engine. Caching is
+        pure host policy — the compiled prefill/decode programs are
+        identical either way — so the serve bench can A/B cold vs warm
+        on one engine without a single retrace. Disabling clears the
+        content index (cached LRU blocks return to the free list;
+        in-flight shared blocks keep their refcounts and drain
+        normally)."""
+        if enabled:
+            if model_fingerprint is not None:
+                self._model_fingerprint = model_fingerprint
+            if self.prefix_cache is None:
+                self.prefix_cache = PrefixCache(
+                    self.pool, fingerprint=self._model_fingerprint
+                )
+        else:
+            self.pool.clear_cache()
+            self.prefix_cache = None
+        self.scheduler.prefix_cache = self.prefix_cache
 
     def export_trace(self, path: str) -> str:
         """Write the last ``span_history`` closed spans (plus any still
@@ -663,4 +819,6 @@ class ServingEngine:
         }
         if self.slo_tracker is not None:
             out["slo"] = self.slo_tracker.snapshot(self._now())
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
         return out

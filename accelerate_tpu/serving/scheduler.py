@@ -57,6 +57,9 @@ class Request:
     # set when the scheduler refuses/evicts the request instead of
     # queueing it: "queue_full" | "queue_deadline"
     shed_reason: Optional[str] = None
+    # prefix caching: the request's rolling content keys, computed ONCE
+    # at first admission attempt and reused at publish time
+    prefix_keys: Optional[list] = None
 
     def __post_init__(self):
         if not self.request_id:
@@ -81,6 +84,18 @@ class Slot:
     admit_time: float = 0.0
     first_token_time: float = 0.0
     finish_time: float = 0.0
+    # prefix caching: table positions currently pointing at SHARED
+    # (read-only) cached blocks; any write into one copy-on-writes first
+    shared: set[int] = field(default_factory=set)
+    # prompt tokens whose KV is already in the cache — prefill skips them
+    cached_tokens: int = 0
+    # block reserved at admission for the full-prompt-hit COW (the tail
+    # must keep >= 1 token, so a hit covering the WHOLE prompt re-writes
+    # the last prompt token into a private copy of its shared block)
+    cow_spare: Optional[int] = None
+    # table positions whose block was COW'd: private now, but partially
+    # recomputed — kept out of the content index
+    cow_indices: set[int] = field(default_factory=set)
 
     @property
     def busy(self) -> bool:
@@ -96,6 +111,10 @@ class Slot:
         self.admit_time = 0.0
         self.first_token_time = 0.0
         self.finish_time = 0.0
+        self.shared = set()
+        self.cached_tokens = 0
+        self.cow_spare = None
+        self.cow_indices = set()
 
 
 class ContinuousScheduler:
@@ -110,6 +129,7 @@ class ContinuousScheduler:
         max_queue: Optional[int] = None,
         max_queue_delay_s: Optional[float] = None,
         adapter_ready: Optional[Callable[[Optional[str]], bool]] = None,
+        prefix_cache=None,
     ):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
@@ -127,6 +147,10 @@ class ContinuousScheduler:
         # resident (prefilling against a not-yet-loaded adapter would
         # silently decode under the identity row). None = no gating.
         self.adapter_ready = adapter_ready
+        # prefix reuse: an optional block_pool.PrefixCache — admission
+        # points new slots' tables at cached chain prefixes instead of
+        # allocating (and later prefilling) private copies
+        self.prefix_cache = prefix_cache
         self.shed_counts = {"queue_full": 0, "queue_deadline": 0}
         self.blocked_reasons = {
             "no_free_slot": 0,
@@ -177,16 +201,32 @@ class ContinuousScheduler:
         return shed
 
     def release(self, slot: Slot) -> None:
-        """Return a finished slot's blocks and empty the seat — the very
-        next :meth:`admit` can refill it (continuous batching's point)."""
+        """Return a finished slot's references and empty the seat — the
+        very next :meth:`admit` can refill it (continuous batching's
+        point). Under prefix caching "return" means RELEASE: a shared
+        block merely drops one refcount, and published blocks at
+        refcount 0 retire into the pool's cached LRU instead of the free
+        list."""
         if slot.blocks:
             self.pool.free(slot.blocks)
+        if slot.cow_spare is not None:  # reserved but never written
+            self.pool.free([slot.cow_spare])
         slot.clear()
 
     def admit(self) -> list[Slot]:
         """Fill free slots from the queue head while the pool can fund
         each request's full reservation. Strict FIFO: a head request that
-        doesn't fit blocks later ones (no starvation of big requests)."""
+        doesn't fit blocks later ones (no starvation of big requests).
+
+        With a prefix cache attached, the head's longest cached
+        block-chain prefix is ACQUIRED (refcounted) instead of allocated,
+        and only the uncached remainder of the footprint comes off the
+        free list — the engine then prefills only the tail. A hit that
+        covers the whole prompt still leaves its LAST token to the tail
+        (the first sampled token needs that position's logits), so one
+        extra private block is reserved for the engine's copy-on-write
+        of the final shared block.
+        """
         admitted = []
         free_slots = (s for s in self.slots if not s.busy)
         while self.queue:
@@ -208,14 +248,37 @@ class ContinuousScheduler:
             need = self.pool.blocks_for_tokens(
                 len(req.prompt) + req.max_new_tokens
             )
-            if not self.pool.can_allocate(need):
+            shared: list[int] = []
+            if self.prefix_cache is not None:
+                if req.prefix_keys is None:
+                    req.prefix_keys = self.prefix_cache.keys_for(
+                        req.prompt, req.adapter
+                    )
+                shared = self.prefix_cache.match(
+                    req.prompt, req.adapter, keys=req.prefix_keys
+                )
+            hit_tokens = len(shared) * self.pool.block_size
+            # tail keeps >= 1 prompt token; a full-prompt hit COWs the
+            # last shared block at prefill time (needs the spare below)
+            cached_tokens = min(hit_tokens, len(req.prompt) - 1)
+            cow_reserve = 1 if hit_tokens > cached_tokens else 0
+            if shared:
+                # pin the chain BEFORE any allocation can LRU-evict it
+                self.pool.acquire(shared)
+            if not self.pool.can_allocate(need - len(shared) + cow_reserve):
                 # a seat is free but the KV pool can't fund the head
+                if shared:
+                    self.pool.free(shared)
                 self.blocked_reasons["pool_exhausted"] += 1
                 break
             self.queue.popleft()
             slot.clear()
             slot.request = req
-            slot.blocks = self.pool.allocate(need)
+            slot.blocks = shared + self.pool.allocate(need - len(shared))
+            slot.shared = set(range(len(shared)))
+            slot.cached_tokens = cached_tokens
+            if cow_reserve:
+                slot.cow_spare = self.pool.allocate(1)[0]
             slot.admit_time = self._now()
             admitted.append(slot)
         return admitted

@@ -48,6 +48,9 @@ class RequestSpan:
     state: str = "queued"  # queued | running | finished | shed
     shed_reason: Optional[str] = None  # "queue_full" | "queue_deadline"
     new_tokens: int = 0
+    # prefix caching: prompt tokens whose KV came from the shared cache
+    # (prefill skipped them) — 0 for cold requests / caching off
+    cached_prefix_tokens: int = 0
 
     @property
     def terminal(self) -> bool:
@@ -80,6 +83,7 @@ class RequestSpan:
             "shed_reason": self.shed_reason,
             "adapter_id": self.adapter_id,
             "prompt_tokens": self.prompt_tokens,
+            "cached_prefix_tokens": self.cached_prefix_tokens,
             "new_tokens": self.new_tokens,
             "submit_t": self.submit_t,
             "admit_t": self.admit_t,
@@ -139,10 +143,13 @@ class SpanLog:
             span.state = "running"
         return span
 
-    def on_prefill(self, request_id: str, t: float) -> Optional[RequestSpan]:
+    def on_prefill(
+        self, request_id: str, t: float, cached_prefix_tokens: int = 0,
+    ) -> Optional[RequestSpan]:
         span = self._open.get(request_id)
         if span is not None:
             span.prefill_start_t = t
+            span.cached_prefix_tokens = cached_prefix_tokens
         return span
 
     def on_first_token(self, request_id: str, t: float) -> Optional[RequestSpan]:
@@ -221,6 +228,7 @@ def spans_to_chrome_trace(
         args = {
             "request_id": span.request_id,
             "prompt_tokens": span.prompt_tokens,
+            "cached_prefix_tokens": span.cached_prefix_tokens,
             "new_tokens": span.new_tokens,
             "state": span.state,
         }

@@ -97,6 +97,8 @@ shed; emitted by the ServingEngine's span log)::
     state            str    "finished" | "shed"
     shed_reason      str?   "queue_full" | "queue_deadline" when shed
     prompt_tokens    int    prompt length
+    cached_prefix_tokens int prompt tokens served from the prefix cache
+                           (prefill skipped them; 0 when caching is off)
     new_tokens       int    tokens generated (0 for shed requests)
     submit_t         float  engine-clock (monotonic) lifecycle stamps;
     admit_t          float? null where the span never reached the edge
@@ -123,7 +125,13 @@ shed; emitted by the ServingEngine's span log)::
     slot_occupancy                       float  slots_active / max_slots
     pool_blocks_free                     int    KV pool posture
     pool_blocks_allocated                int
+    pool_blocks_cached                   int    refcount-0 blocks in the
+                                                prefix-cache LRU
     pool_utilization                     float
+    shared_blocks                        int    blocks held by >= 2 slots
+    prefix_cache_hit_rate                float  lookups hitting >= 1 block
+    cow_copies_total                     int    copy-on-write block copies
+    prefill_tokens_saved_total           int    prompt tokens never prefilled
     tokens_in_flight                     int    KV tokens held by active slots
     admission_blocked_no_free_slot_total  int   admit() stalls: batch full
     admission_blocked_pool_exhausted_total int  admit() stalls: pool empty

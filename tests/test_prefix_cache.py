@@ -1,0 +1,494 @@
+"""Prefix caching: refcounted shared KV blocks + copy-on-write.
+
+Four layers, matching the feature's split: pool bookkeeping (refcounts,
+content index, cached LRU — pure host policy, no jax), the rolling-hash
+keying scheme (tenant/model isolation by construction), the engine's
+warm path (shared-prefix admission, tail prefill, COW — outputs must be
+bitwise identical to a cold run with zero decode retraces), and the
+observability plumbing (gauges, spans, Prometheus export).
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.models import CausalLM, TransformerConfig
+from accelerate_tpu.serving import (
+    BlockPool,
+    PrefixCache,
+    ServingEngine,
+    prefix_keys,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    return cfg, model, params
+
+
+def _invariant(pool: BlockPool) -> bool:
+    """The pool's conservation law: every allocatable block is in exactly
+    one of FREE / ALLOCATED / CACHED (the garbage block is in none)."""
+    return (
+        pool.num_free + pool.num_allocated + pool.num_cached
+        == pool.num_blocks - 1
+    )
+
+
+# ---------------------------------------------------------------------- #
+# pool: refcounts
+# ---------------------------------------------------------------------- #
+def test_refcount_acquire_release_roundtrip():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    blocks = pool.allocate(2)
+    assert all(pool.refcount(b) == 1 for b in blocks)
+    pool.acquire(blocks)  # second holder
+    assert all(pool.refcount(b) == 2 for b in blocks)
+    assert pool.num_shared == 2
+    pool.free(blocks)  # first holder releases: blocks stay live
+    assert all(pool.refcount(b) == 1 for b in blocks)
+    assert pool.num_free == 5  # nothing returned yet
+    pool.free(blocks)  # refcount 0, unpublished -> free list
+    assert all(pool.refcount(b) == 0 for b in blocks)
+    assert pool.num_free == 7
+    assert _invariant(pool)
+
+
+def test_double_free_raises():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    (b,) = pool.allocate(1)
+    pool.free([b])
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.free([b])
+    assert _invariant(pool)
+
+
+def test_free_while_shared_keeps_block_live():
+    """A shared block survives any single holder's release — the other
+    holder's KV can never be pulled out from under it."""
+    pool = BlockPool(num_blocks=8, block_size=4)
+    (b,) = pool.allocate(1)
+    pool.acquire([b])
+    pool.free([b])
+    assert pool.refcount(b) == 1  # still someone's block
+    assert b not in pool._free
+    # over-freeing past the last reference is the double-free error
+    pool.free([b])
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.free([b])
+
+
+def test_acquire_unknown_block_raises_and_rolls_back():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    blocks = pool.allocate(2)
+    with pytest.raises(ValueError, match="neither allocated nor cached"):
+        pool.acquire(blocks + [99])  # partial chain must roll back
+    assert all(pool.refcount(b) == 1 for b in blocks)
+    assert _invariant(pool)
+
+
+# ---------------------------------------------------------------------- #
+# pool: content index + cached LRU
+# ---------------------------------------------------------------------- #
+def test_published_block_retires_to_cache_and_is_reacquirable():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    (b,) = pool.allocate(1)
+    key = b"k" * 32
+    assert pool.publish(b, key) == b
+    pool.free([b])
+    assert pool.num_cached == 1 and pool.num_free == 6
+    assert pool.lookup([key]) == [b]
+    pool.acquire([b])  # the warm-hit path: cached -> allocated
+    assert pool.refcount(b) == 1 and pool.num_cached == 0
+    assert _invariant(pool)
+
+
+def test_publish_first_writer_wins():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    a, b = pool.allocate(2)
+    key = b"same-key" * 4
+    assert pool.publish(a, key) == a
+    # concurrent identical prefill: the second publisher is told the
+    # canonical block; its own stays private
+    assert pool.publish(b, key) == a
+    assert pool.lookup([key]) == [a]
+
+
+def test_lru_eviction_prefers_coldest_and_never_touches_refcounted():
+    pool = BlockPool(num_blocks=6, block_size=4)
+    blocks = pool.allocate(5)  # everything
+    keys = [bytes([i]) * 32 for i in range(5)]
+    for b, k in zip(blocks, keys):
+        pool.publish(b, k)
+    pool.free(blocks)  # all 5 retire to the LRU, oldest-first
+    assert pool.num_cached == 5 and pool.num_free == 0
+    pool.acquire([blocks[0]])  # pin the coldest
+    got = pool.allocate(2)  # pressure: must evict from the LRU
+    assert blocks[0] not in got  # refcount>0 is never evicted
+    assert pool.lookup([keys[0]]) == [blocks[0]]  # still indexed
+    # the two coldest UNPINNED entries were evicted, their keys dropped
+    assert pool.lookup([keys[1]]) == []
+    assert pool.evictions_total == 2
+    assert _invariant(pool)
+
+
+def test_can_allocate_counts_cached_as_capacity():
+    pool = BlockPool(num_blocks=6, block_size=4)
+    blocks = pool.allocate(5)
+    for i, b in enumerate(blocks):
+        pool.publish(b, bytes([i]) * 32)
+    pool.free(blocks)
+    assert pool.num_free == 0
+    assert pool.can_allocate(5)  # a hot cache never blocks admission
+    assert not pool.can_allocate(6)
+
+
+def test_clear_cache_returns_lru_blocks_to_free_list():
+    pool = BlockPool(num_blocks=6, block_size=4)
+    blocks = pool.allocate(3)
+    for i, b in enumerate(blocks):
+        pool.publish(b, bytes([i]) * 32)
+    pool.free(blocks[:2])  # two cached, one still in flight
+    pool.clear_cache()
+    assert pool.num_cached == 0 and pool.num_free == 4
+    assert pool.lookup([bytes([2]) * 32]) == []  # in-flight unindexed too
+    assert pool.refcount(blocks[2]) == 1  # ... but still its holder's
+    assert _invariant(pool)
+
+
+def test_pool_fuzz_invariant_holds_after_every_op():
+    """Randomized allocate/free/acquire/publish/lookup churn: the
+    conservation law must hold after EVERY op, and no op may corrupt a
+    neighbour's refcount."""
+    rng = random.Random(0)
+    pool = BlockPool(num_blocks=17, block_size=4)
+    held: list[int] = []  # one entry per reference we own
+    published = 0
+    for _ in range(2000):
+        op = rng.random()
+        if op < 0.35 and pool.can_allocate(n := rng.randint(1, 3)):
+            held.extend(pool.allocate(n))
+        elif op < 0.55 and held:
+            b = held.pop(rng.randrange(len(held)))
+            pool.free([b])
+        elif op < 0.70 and held:
+            b = held[rng.randrange(len(held))]
+            pool.acquire([b])
+            held.append(b)
+        elif op < 0.85 and held:
+            b = held[rng.randrange(len(held))]
+            pool.publish(b, published.to_bytes(4, "big") * 8)
+            published += 1
+        elif pool.num_cached:
+            # warm hit on a random cached block
+            b = next(iter(pool._lru))
+            pool.acquire([b])
+            held.append(b)
+        assert _invariant(pool), "conservation law broken mid-fuzz"
+        # our ledger and the pool's must agree exactly
+        counts: dict[int, int] = {}
+        for b in held:
+            counts[b] = counts.get(b, 0) + 1
+        assert all(pool.refcount(b) == n for b, n in counts.items())
+    for b in held:
+        pool.free([b])
+    assert _invariant(pool)
+    assert pool.num_allocated == 0
+
+
+# ---------------------------------------------------------------------- #
+# keying scheme
+# ---------------------------------------------------------------------- #
+def test_prefix_keys_are_rolling_and_full_blocks_only():
+    toks = list(range(10))
+    keys = prefix_keys("fp", None, toks, block_size=4)
+    assert len(keys) == 2  # 10 tokens / 4 = 2 full blocks, tail unkeyed
+    # same prefix -> same keys; a divergent SECOND block changes only
+    # keys from that block on (key[0] commits to block 0 alone)
+    other = prefix_keys("fp", None, toks[:4] + [99] * 4, block_size=4)
+    assert other[0] == keys[0] and other[1] != keys[1]
+    # a divergent FIRST block changes every key (rolling hash chains)
+    shifted = prefix_keys("fp", None, [99] + toks[1:], block_size=4)
+    assert shifted[0] != keys[0] and shifted[1] != keys[1]
+
+
+def test_prefix_keys_fold_in_adapter_and_fingerprint():
+    toks = list(range(8))
+    base = prefix_keys("fp", None, toks, 4)
+    # two tenants with identical prompts get fully disjoint key chains
+    assert set(prefix_keys("fp", "tenant-a", toks, 4)).isdisjoint(base)
+    assert set(prefix_keys("fp", "tenant-a", toks, 4)).isdisjoint(
+        prefix_keys("fp", "tenant-b", toks, 4)
+    )
+    # and so do two different models
+    assert set(prefix_keys("fp2", None, toks, 4)).isdisjoint(base)
+
+
+def test_prefix_cache_match_isolates_tenants():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    cache = PrefixCache(pool, fingerprint="fp")
+    toks = list(range(8))
+    blocks = pool.allocate(2)
+    cache.publish(toks, "tenant-a", blocks)
+    assert cache.match(toks, "tenant-a") == blocks
+    assert cache.match(toks, "tenant-b") == []  # never cross-served
+    assert cache.match(toks, None) == []
+
+
+# ---------------------------------------------------------------------- #
+# engine: warm path, COW, bitwise parity
+# ---------------------------------------------------------------------- #
+def _drain(engine, prompt, max_new=6, adapter=None):
+    rid = engine.add_request(
+        list(prompt), max_new_tokens=max_new, adapter=adapter
+    )
+    for _ in engine.stream():
+        pass
+    return engine.result(rid)
+
+
+def test_warm_hit_skips_prefill_and_matches_cold_bitwise(tiny_model):
+    cfg, model, params = tiny_model
+    template = list(range(1, 17))  # 4 full blocks of 4
+    prompts = [template + [21, 22, 23], template + [31, 32], template]
+    cold = ServingEngine(model, params, max_slots=2, block_size=4, seed=7)
+    warm = ServingEngine(
+        model, params, max_slots=2, block_size=4, seed=7, prefix_cache=True
+    )
+    cold_out = [_drain(cold, p) for p in prompts]
+    warm_out = [_drain(warm, p) for p in prompts]
+    assert cold_out == warm_out  # caching changes WHEN KV is computed,
+    # never WHAT is computed
+    stats = warm.prefix_cache.stats()
+    assert stats["hits"] == 2  # requests 2 and 3 reuse request 1's chain
+    assert stats["prefill_tokens_saved_total"] == 16 + 15
+    # request 3's prompt == the cached chain exactly: the >= 1-token
+    # tail re-writes the last shared block -> exactly one COW
+    assert stats["cow_copies_total"] == 1
+    # decode compiled ONCE across both engines' traffic
+    assert warm.trace_counts()["decode"] == 1
+    pool = warm.pool
+    assert (
+        pool.num_free + pool.num_allocated + pool.num_cached
+        == pool.num_blocks - 1
+    )
+
+
+def test_cow_leaves_donor_chain_intact(tiny_model):
+    """After the full-prompt-hit COW, the DONOR blocks stay published:
+    a later identical request must still hit the original chain (the
+    copy serviced one writer; the canonical content is untouched)."""
+    cfg, model, params = tiny_model
+    template = list(range(1, 13))  # 3 full blocks of 4
+    engine = ServingEngine(
+        model, params, max_slots=2, block_size=4, seed=3, prefix_cache=True
+    )
+    first = _drain(engine, template)  # publishes the chain
+    second = _drain(engine, template)  # full hit -> COW of last block
+    assert engine.prefix_cache.cow_copies_total == 1
+    third = _drain(engine, template)  # must STILL hit the intact chain
+    assert engine.prefix_cache.stats()["hits"] == 2
+    assert engine.prefix_cache.cow_copies_total == 2
+    assert first == second == third
+    cold = ServingEngine(model, params, max_slots=2, block_size=4, seed=3)
+    assert _drain(cold, template) == first
+
+
+def test_tenant_a_cached_prefix_never_serves_tenant_b(tiny_model):
+    """Two tenants, identical prompts: tenant A warms the cache, tenant
+    B must MISS (adapter_id is folded into every key) and produce output
+    bitwise equal to its own cold single-tenant reference."""
+    from accelerate_tpu.adapters import AdapterRegistry, LoraConfig, init_adapter
+    from accelerate_tpu.adapters.runtime import A_KEY, B_KEY
+
+    cfg, model, params = tiny_model
+    lcfg = LoraConfig(rank=4, alpha=8.0, target_modules=("q_proj", "v_proj"))
+
+    def rand_adapter(seed):
+        ad = init_adapter(jax.random.PRNGKey(seed), cfg, lcfg)
+        return {
+            t: {
+                A_KEY: pair[A_KEY],
+                B_KEY: 0.05 * jax.random.normal(
+                    jax.random.PRNGKey(seed * 977 + i), pair[B_KEY].shape
+                ),
+            }
+            for i, (t, pair) in enumerate(sorted(ad.items()))
+        }
+
+    def fresh(prefix_cache):
+        reg = AdapterRegistry(
+            cfg, capacity=2, max_rank=lcfg.rank,
+            target_modules=lcfg.target_modules,
+        )
+        reg.load("tenant-a", rand_adapter(11), lcfg)
+        reg.load("tenant-b", rand_adapter(22), lcfg)
+        return ServingEngine(
+            model, params, max_slots=2, block_size=4, seed=5,
+            adapters=reg, prefix_cache=prefix_cache,
+        )
+
+    prompt = list(range(1, 13))
+    engine = fresh(prefix_cache=True)
+    out_a = _drain(engine, prompt, adapter="tenant-a")
+    assert engine.prefix_cache.hits == 0  # A was cold
+    out_b = _drain(engine, prompt, adapter="tenant-b")
+    assert engine.prefix_cache.hits == 0  # B MISSED A's chain
+    # A's own repeat DOES hit — the index works, it just isolates
+    assert _drain(engine, prompt, adapter="tenant-a") == out_a
+    assert engine.prefix_cache.hits == 1
+    # B's warm-engine output equals B alone on a cold engine
+    cold = fresh(prefix_cache=False)
+    _drain(cold, prompt, adapter="tenant-a")
+    assert _drain(cold, prompt, adapter="tenant-b") == out_b
+
+
+def test_set_prefix_cache_toggles_on_warm_engine_without_retrace(tiny_model):
+    cfg, model, params = tiny_model
+    engine = ServingEngine(model, params, max_slots=2, block_size=4, seed=1)
+    template = list(range(1, 17))
+    cold = _drain(engine, template + [5])
+    engine.set_prefix_cache(True)  # warm toggle: pure host policy
+    assert _drain(engine, template + [5]) == cold  # publishes
+    assert _drain(engine, template + [5]) == cold  # first hit: its tail
+    # bucket compiles once, like any prompt-width warmup
+    traces = engine.trace_counts()
+    assert _drain(engine, template + [5]) == cold  # steady-state hit
+    assert engine.prefix_cache.hits == 2
+    assert engine.trace_counts() == traces  # not one new program
+    assert traces["decode"] == 1  # decode NEVER retraced across toggles
+    engine.set_prefix_cache(False)
+    assert engine.pool.num_cached == 0  # OFF clears the index
+    assert engine.prefix_cache is None
+    assert _drain(engine, template + [5]) == cold
+
+
+def test_pool_exhaustion_rolls_back_acquired_prefix(tiny_model):
+    """If the pool can't fund a request's UNCACHED remainder, admission
+    must release the chain it just pinned (no leaked refcounts)."""
+    cfg, model, params = tiny_model
+    engine = ServingEngine(
+        model, params, max_slots=2, block_size=4, num_blocks=16,
+        prefix_cache=True, seed=2,
+    )
+    template = list(range(1, 17))  # 4 blocks
+    _drain(engine, template, max_new=4)  # publish the chain
+    assert engine.pool.num_cached == 4
+    held = engine.pool.allocate(5)  # external pressure: free drops to 6
+    # needs 4 shared + 9 private but only 6 free: blocked, chain released
+    rid = engine.add_request(template + [7] * 15, max_new_tokens=20)
+    engine.step()
+    assert engine.result(rid) is None
+    assert engine.scheduler.blocked_reasons["pool_exhausted"] >= 1
+    pool = engine.pool
+    assert pool.num_allocated == 5  # only our hold: nothing leaked
+    assert pool.num_cached == 4  # the pinned chain went BACK to cached
+    assert all(pool.refcount(b) == 0 for b in pool._lru)
+    pool.free(held)
+    assert (
+        pool.num_free + pool.num_allocated + pool.num_cached
+        == pool.num_blocks - 1
+    )
+
+
+# ---------------------------------------------------------------------- #
+# observability plumbing
+# ---------------------------------------------------------------------- #
+def test_gauges_spans_and_prometheus_export(tiny_model):
+    from accelerate_tpu.telemetry import PrometheusTextSink, StepTelemetry
+
+    cfg, model, params = tiny_model
+    tele = StepTelemetry(True)
+    prom = PrometheusTextSink(path=None)
+    tele.add_sink(prom)
+    engine = ServingEngine(
+        model, params, max_slots=2, block_size=4, seed=9,
+        prefix_cache=True, telemetry=tele,
+    )
+    template = list(range(1, 17))
+    _drain(engine, template + [3])
+    _drain(engine, template + [4])
+    gauges = engine._gauge_fields()
+    assert gauges["prefix_cache_hit_rate"] == 0.5
+    assert gauges["prefill_tokens_saved_total"] == 16
+    assert "shared_blocks" in gauges and "cow_copies_total" in gauges
+    assert gauges["pool_blocks_cached"] == engine.pool.num_cached
+    # the warm request's span carries the cached token count
+    spans = {s.request_id: s for s in engine.span_log.closed}
+    assert sorted(
+        s.cached_prefix_tokens for s in spans.values()
+    ) == [0, 16]
+    assert all(
+        "cached_prefix_tokens" in s.to_record() for s in spans.values()
+    )
+    text = prom.render()
+    assert "accelerate_tpu_serve_prefix_cache_hit_rate" in text
+    assert "accelerate_tpu_serve_shared_blocks" in text
+    assert "accelerate_tpu_serve_cow_copies_total" in text
+    assert "accelerate_tpu_serve_prefill_tokens_saved_total" in text
+    assert engine.summary()["prefix_cache"]["hits"] == 1
+    tele.close()
+
+
+# ---------------------------------------------------------------------- #
+# the prefix-smoke acceptance scenario (make prefix-smoke)
+# ---------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_prefix_smoke_end_to_end(tiny_model):
+    """Two requests share a long template: the second must skip prefill
+    for every shared full block and decode bitwise-equal to a cold-cache
+    control; a third divergent request (prompt == the cached chain
+    exactly) exercises copy-on-write and still matches ITS cold control
+    — all with zero decode retraces after warmup."""
+    cfg, model, params = tiny_model
+    bs = 4
+    template = [(7 * i + 3) % cfg.vocab_size for i in range(40)]  # 10 blocks
+    first = template + [101, 102, 103]
+    second = template + [201, 202]
+    divergent = list(template)  # full-prompt hit -> COW path
+
+    cold = ServingEngine(model, params, max_slots=2, block_size=bs, seed=13)
+    control = {
+        "first": _drain(cold, first, max_new=8),
+        "second": _drain(cold, second, max_new=8),
+        "divergent": _drain(cold, divergent, max_new=8),
+    }
+
+    engine = ServingEngine(
+        model, params, max_slots=2, block_size=bs, seed=13, prefix_cache=True
+    )
+    out_first = _drain(engine, first, max_new=8)  # cold: publishes chain
+    decode_traces_warm = engine.trace_counts()["decode"]
+    saved0 = engine.prefix_cache.tokens_saved_total
+
+    out_second = _drain(engine, second, max_new=8)
+    # the second request skipped prefill for EVERY shared full block
+    shared_tokens = len(template) // bs * bs
+    assert engine.prefix_cache.tokens_saved_total - saved0 >= shared_tokens
+    span = {s.request_id: s for s in engine.span_log.closed}
+    assert max(
+        s.cached_prefix_tokens for s in span.values()
+    ) == shared_tokens
+    assert out_second == control["second"]  # bitwise equal to cold
+
+    cow0 = engine.prefix_cache.cow_copies_total
+    out_divergent = _drain(engine, divergent, max_new=8)
+    assert engine.prefix_cache.cow_copies_total > cow0  # COW exercised
+    assert out_divergent == control["divergent"]
+    assert out_first == control["first"]
+    # zero decode retraces across the whole warm phase
+    assert engine.trace_counts()["decode"] == decode_traces_warm == 1
+    # and the pool's conservation law survived the churn
+    pool = engine.pool
+    assert (
+        pool.num_free + pool.num_allocated + pool.num_cached
+        == pool.num_blocks - 1
+    )
