@@ -33,11 +33,11 @@ FAST_FILES = \
   tests/test_serving.py tests/test_serving_obs.py \
   tests/test_elastic.py tests/test_fused_kernels.py \
   tests/test_slice_mesh.py tests/test_adapters.py \
-  tests/test_prefix_cache.py
+  tests/test_prefix_cache.py tests/test_speculation.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
   diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke \
-  slice-smoke kernels-smoke lora-smoke prefix-smoke
+  slice-smoke kernels-smoke lora-smoke prefix-smoke spec-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -154,6 +154,19 @@ prefix-smoke:
 	JAX_PLATFORMS=cpu $(PYTEST) -q \
 	  tests/test_prefix_cache.py::test_tenant_a_cached_prefix_never_serves_tenant_b \
 	  tests/test_prefix_cache.py::test_prefix_smoke_end_to_end
+
+# speculative-decoding acceptance on CPU (~60s): a spec-off /
+# SpecConfig(k=0) engine is token-for-token AND key-stream identical to
+# a plain engine; a self-consistent draft (upper target layers are exact
+# no-ops) accepts 100% of drafts while decoding bitwise-equal to the
+# spec-off control; verify compiles ONCE, warm set_speculation() toggles
+# add zero retraces, and a speculative write into a shared CACHED block
+# copies-on-write first (slow-marked e2e, so it runs here but not in
+# tier 1; the retrace-free toggle test rides along as preflight)
+spec-smoke:
+	JAX_PLATFORMS=cpu $(PYTEST) -q \
+	  tests/test_speculation.py::test_verify_traces_once_and_toggle_is_retrace_free \
+	  tests/test_speculation.py::test_spec_smoke_end_to_end
 
 # multi-tenant adapter acceptance on CPU (~30s): train a LoRA adapter
 # through unified_step (adapter-only carry), commit its checkpoint

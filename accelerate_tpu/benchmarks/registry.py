@@ -155,10 +155,12 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
             # args: (cfg, max_slots, block_size, n_requests, seed)
             # estimate covers the headline engine+baseline passes, the
             # observability-overhead A/B rounds (4 extra trace replays
-            # on the warm engine), and the prefix-caching cold/warm A/B
-            # on the templated cohort (2 warmup + 2 timed passes)
+            # on the warm engine), the prefix-caching cold/warm A/B on
+            # the templated cohort (2 warmup + 2 timed passes), and the
+            # speculation A/B (3 arms, each a fresh engine compiling its
+            # own program set plus a warmup + timed drain)
             _variant("serve", "serve", 3, "serve", (tiny, 4, 8, 16, 0),
-                     default_estimate_s=150),
+                     default_estimate_s=240),
             _variant("ckpt", "ckpt", 3, "ckpt", (tiny, 4, 64, 8, 2),
                      fast=True, default_estimate_s=15),
             # adapter-only vs full fine-tune economics + the multi-tenant
@@ -289,7 +291,7 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
         # process and resident weights-compile budget); args:
         # (cfg, max_slots, block_size, n_requests, seed)
         _variant("serve", "serve", 3, "decode", (decode, 4, 16, 8, 0),
-                 default_estimate_s=1700),
+                 default_estimate_s=2000),
         _variant("moe", "train", 3, "moe", (moe, 16, 1024, 20, 3),
                  default_estimate_s=600),
         _variant("longseq", "train", 3, "longseq", (longseq, 1, 8192, 8, 2),

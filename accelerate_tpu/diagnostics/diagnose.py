@@ -214,6 +214,8 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
                 "tokens_in_flight",
                 "prefix_cache_hit_rate", "shared_blocks",
                 "cow_copies_total", "prefill_tokens_saved_total",
+                "spec_rounds", "spec_tokens_proposed",
+                "spec_tokens_accepted", "spec_accept_rate",
                 "admission_blocked_no_free_slot_total",
                 "admission_blocked_pool_exhausted_total",
                 "shed_queue_full_total", "shed_queue_deadline_total",
@@ -377,6 +379,14 @@ def format_report(report: dict) -> str:
                     f"shared_blocks={s.get('shared_blocks') or 0} "
                     f"cow_copies={s.get('cow_copies_total') or 0} "
                     f"prefill_tokens_saved={saved or 0}"
+                )
+            if s.get("spec_tokens_proposed"):
+                lines.append(
+                    f"    speculation: "
+                    f"accept_rate={s.get('spec_accept_rate') or 0.0:.1%} "
+                    f"proposed={s.get('spec_tokens_proposed') or 0} "
+                    f"accepted={s.get('spec_tokens_accepted') or 0} "
+                    f"rounds={s.get('spec_rounds') or 0}"
                 )
             if s.get("slo_target") is not None:
                 ttft = s.get("slo_ttft_attainment")

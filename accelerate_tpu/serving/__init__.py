@@ -22,11 +22,14 @@ from .spans import (
     spans_to_chrome_trace,
     write_chrome_trace,
 )
+from .speculation import DraftModelProposer, NGramProposer, SpecConfig
 from .telemetry import ServeStats, percentile
 
 __all__ = [
     "BlockPool",
     "ContinuousScheduler",
+    "DraftModelProposer",
+    "NGramProposer",
     "PagedKVState",
     "PrefixCache",
     "Request",
@@ -38,6 +41,7 @@ __all__ = [
     "SlotSampling",
     "SloTracker",
     "SpanLog",
+    "SpecConfig",
     "TokenEvent",
     "paged_attention",
     "paged_update",

@@ -16,6 +16,13 @@ events. Two compiled programs do all device work after warmup:
   the paged cache instead of stalling the decode batch (prefill/decode
   split).
 
+With speculative decoding enabled (``spec_decode=SpecConfig(...)`` /
+:meth:`ServingEngine.set_speculation`) a third program joins them: ONE
+verification step at ``(max_slots, k + 1)`` that scores a proposer's k
+draft tokens per slot in a single target pass, lifting throughput past
+the one-token-per-slot-per-step wall at token-for-token identical
+outputs (see :mod:`.speculation`).
+
 Zero-retrace is an explicit contract: trace-time counters
 (:meth:`ServingEngine.trace_counts`) let tests assert it.
 """
@@ -38,6 +45,7 @@ from .sampling import SlotSampling, sample_tokens
 from .scheduler import ContinuousScheduler, Request, Slot
 from .slo import SLOConfig, SloTracker
 from .spans import SpanLog, write_chrome_trace
+from .speculation import DraftModelProposer, NGramProposer, SpecConfig
 from .telemetry import ServeStats, percentile
 
 
@@ -113,6 +121,7 @@ class ServingEngine:
         adapters: Any = None,
         prefix_cache: bool = False,
         model_fingerprint: Optional[str] = None,
+        spec_decode: Optional[SpecConfig] = None,
     ):
         self.model = model
         self.params = params
@@ -150,6 +159,7 @@ class ServingEngine:
                 else None
             ),
             prefix_cache=self.prefix_cache,
+            max_table_blocks=self._max_table,
         )
         self.sampling = SlotSampling(max_slots)
         self.stats = ServeStats()
@@ -165,6 +175,10 @@ class ServingEngine:
         self._now = now
         self._key = jax.random.PRNGKey(seed)
         self._tables = np.zeros((max_slots, self._max_table), np.int32)
+        # cached device copy of the block tables — invalidated on every
+        # host-side table write, so the per-iteration decode/verify call
+        # skips a host->device put when no admission/COW/retire happened
+        self._tables_dev: Optional[jax.Array] = None
         # host mirror of each slot's adapter stack row (0 = base model),
         # turned into a traced array per decode step — SlotSampling's idiom
         self._slot_adapter = np.zeros(max_slots, np.int32)
@@ -174,7 +188,7 @@ class ServingEngine:
         self._shed_order: collections.deque = collections.deque()
         self._steps = 0
         self._http: Any = None
-        self._traces = {"prefill": 0, "decode": 0, "cow": 0}
+        self._traces = {"prefill": 0, "decode": 0, "cow": 0, "verify": 0}
 
         from ..models.generation import init_cache
 
@@ -283,11 +297,59 @@ class ServingEngine:
                 return leaf
             return jax.tree.map(copy, cache)
 
+        def _make_verify(width: int):
+            # Speculative verification: ONE target pass at the fixed
+            # (max_slots, width = k + 1) shape scores the pending token
+            # plus every draft. Column j's logits see positions <=
+            # cache_len + j (the paged causal mask), and its sample uses
+            # chain key j — so out[:, j] is EXACTLY the token plain
+            # decode would emit as the j-th token of this round, making
+            # draft acceptance lossless at any temperature. Per-slot
+            # ``lengths`` (validity) is traced data: the program traces
+            # ONCE per width, the zero-retrace contract's new leg.
+            def _verify(params, cache, tokens, tables, cache_lens, lengths,
+                        temps, keys, *lora_args):
+                traces["verify"] += 1
+                state = PagedKVState(
+                    block_table=tables,
+                    cache_len=cache_lens,
+                    lengths=lengths,
+                    num_blocks=num_blocks,
+                    block_size=block_size,
+                )
+                logits, mutated = model.apply(
+                    {"params": params, "cache": cache}, tokens, decode=True,
+                    paged=state, mutable=["cache"],
+                    **_lora_kwargs(lora_args),
+                )
+                outs = [
+                    sample_tokens(
+                        logits[:, j], keys[j], temps, top_k=top_k, top_p=top_p
+                    )
+                    for j in range(width)
+                ]
+                return mutated["cache"], jnp.stack(outs, axis=1)
+
+            return jax.jit(_verify)
+
         self._prefill_fn = jax.jit(_prefill)
         self._decode_fn = jax.jit(_decode)
         self._cow_fn = jax.jit(_cow)
         self._key_chain_fn = jax.jit(_key_chain)
         self._key_buf: collections.deque = collections.deque()
+        # speculative decoding: verify programs cached by width (k + 1)
+        # and warm proposers cached by config identity, so set_speculation
+        # toggles on a warm engine never retrace
+        self._make_verify = _make_verify
+        self._verify_fns: dict[int, Any] = {}
+        self._proposers: dict[int, Any] = {}
+        self._spec: Optional[SpecConfig] = None
+        self._proposer: Any = None
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
+        self._spec_rounds_total = 0
+        if spec_decode is not None:
+            self.set_speculation(spec_decode)
 
     # ------------------------------------------------------------------ #
     # request API
@@ -332,10 +394,16 @@ class ServingEngine:
         return self.scheduler.has_work
 
     def trace_counts(self) -> dict:
-        """{"prefill": n, "decode": m} — compiled-program counts, bumped
-        at trace time. After warmup, steady-state serving must hold
-        decode at 1 and prefill at <= log2(max_seq_len)."""
-        return dict(self._traces)
+        """Compiled-program counts, bumped at trace time. After warmup,
+        steady-state serving must hold ``decode`` at 1, ``prefill`` at
+        <= log2(max_seq_len), and — with speculation on — ``verify`` at
+        1 per distinct k (plus the draft proposer's own
+        ``draft_prefill``/``draft_step`` counters, merged here)."""
+        out = dict(self._traces)
+        for proposer in self._proposers.values():
+            for name, count in proposer.trace_counts().items():
+                out[name] = out.get(name, 0) + count
+        return out
 
     def result(self, request_id: str) -> Optional[list[int]]:
         """Generated tokens of a COMPLETED request. None while the
@@ -374,7 +442,15 @@ class ServingEngine:
             self._prefill_slot(slot, events)
         active = [s for s in self.scheduler.slots if s.busy and not s.done]
         if active:
-            self._decode_step(active, events)
+            # speculate only when some slot holds a +k block reservation
+            # (granted at admission) — slots seated before speculation
+            # was enabled have no verify headroom and decode plainly
+            if self._proposer is not None and any(
+                s.lookahead > 0 for s in active
+            ):
+                self._spec_step(active, events)
+            else:
+                self._decode_step(active, events)
         self._steps += 1
         if self.gauge_interval and self._steps % self.gauge_interval == 0:
             self._sample_gauges()
@@ -447,6 +523,27 @@ class ServingEngine:
             self._key_buf.extend(np.asarray(subs))
         return jnp.asarray(self._key_buf.popleft())
 
+    def _peek_keys(self, n: int) -> list:
+        """The next ``n`` chain keys WITHOUT consuming them. The verify
+        pass samples position j with key j, but the chain must advance
+        per EMITTED token — a round that commits m + 1 tokens consumes
+        exactly m + 1 keys (:meth:`_consume_keys`), so the sampler
+        stream stays bit-identical to plain decode under any accept
+        pattern (the k=0 / spec-off parity contract)."""
+        while len(self._key_buf) < n:
+            self._key, subs = self._key_chain_fn(self._key)
+            self._key_buf.extend(np.asarray(subs))
+        return [self._key_buf[i] for i in range(n)]
+
+    def _consume_keys(self, n: int) -> None:
+        for _ in range(n):
+            self._key_buf.popleft()
+
+    def _tables_device(self) -> jax.Array:
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self._tables)
+        return self._tables_dev
+
     def _lora_call_args(self, slot_ids) -> tuple:
         """The (stacks, scales, slot_ids) tail every compiled call takes
         when a registry is attached — pure traced DATA: residency churn
@@ -478,11 +575,17 @@ class ServingEngine:
             jnp.asarray(donor, jnp.int32),
             jnp.asarray(private, jnp.int32),
         )
+        if self._proposer is not None:
+            # the draft cache shares the block id space — mirror the
+            # copy so the private block's draft rows stay coherent
+            self._proposer.cow(self._cow_fn, jnp.asarray(donor, jnp.int32),
+                               jnp.asarray(private, jnp.int32))
         slot.blocks[tindex] = private
         self.pool.free([donor])
         slot.shared.discard(tindex)
         slot.cow_indices.add(tindex)
         self._tables[slot.index, tindex] = private
+        self._tables_dev = None
         if self.prefix_cache is not None:
             self.prefix_cache.cow_copies_total += 1
 
@@ -539,6 +642,11 @@ class ServingEngine:
         slot.first_token_time = self._now()
         self.span_log.on_first_token(req.request_id, slot.first_token_time)
         self._tables[slot.index] = table[0]
+        self._tables_dev = None
+        if self._proposer is not None and slot.lookahead > 0:
+            # seed the proposer (the draft model prefills the FULL
+            # prompt through its own paged cache; n-gram is a no-op)
+            self._proposer.prefill_slot(slot)
         self.sampling.set_slot(slot.index, req.temperature)
         self._note_token(slot, token, events)
 
@@ -559,7 +667,7 @@ class ServingEngine:
             lengths[slot.index] = 1
         self.cache, out = self._decode_fn(
             self.params, self.cache, jnp.asarray(tokens),
-            jnp.asarray(self._tables), jnp.asarray(cache_lens),
+            self._tables_device(), jnp.asarray(cache_lens),
             jnp.asarray(lengths), self.sampling.temperatures(),
             self._split_key(),
             *self._lora_call_args(self._slot_adapter),
@@ -571,6 +679,96 @@ class ServingEngine:
             slot.pending = token
             slot.generated.append(token)
             self._note_token(slot, token, events)
+
+    def _spec_step(self, active: list[Slot], events: list[TokenEvent]) -> None:
+        """One speculative iteration: propose up to k tokens per slot,
+        verify pending + drafts in ONE compiled pass at ``(max_slots,
+        k + 1)``, commit the longest target-agreeing prefix host-side.
+        Accepted drafts' KV was written BY the verify pass — commit is
+        just cursor advancement; rejection leaves the cursor short of
+        the stale writes, which the next round's position-addressed
+        writes overwrite (no copies). The only blocks the verify writes
+        can touch beyond plain decode's are the +lookahead reservation,
+        so a SHARED (prefix-cached) block anywhere in that span is
+        copied-on-write up front, before any speculative write."""
+        k = self._spec.k
+        width = k + 1
+        for slot in active:
+            # COW the whole speculative write span [cache_len, cache_len
+            # + lookahead]. Under block-aligned admission shared blocks
+            # sit strictly below the cursor's block, so this loop firing
+            # means a boundary case (full-prompt hit) — same defensive
+            # posture as _decode_step, widened by the lookahead.
+            span = slot.lookahead
+            hi = min(
+                (slot.cache_len + span) // self.block_size,
+                len(slot.blocks) - 1,
+            )
+            for t in range(slot.cache_len // self.block_size, hi + 1):
+                if t in slot.shared:
+                    self._cow_block(slot, t)
+        spec_slots = [s for s in active if s.lookahead > 0]
+        drafts = self._proposer.propose(spec_slots, self._tables_device())
+        if not any(drafts.values()):
+            # nothing proposed this round (n-gram miss everywhere): the
+            # plain decode program is the cheaper identical-output path,
+            # and it consumes one chain key exactly like a 0-draft verify
+            self._decode_step(active, events)
+            self._spec_rounds_total += 1
+            return
+        tokens = np.zeros((self.max_slots, width), np.int32)
+        cache_lens = np.zeros(self.max_slots, np.int32)
+        lengths = np.zeros(self.max_slots, np.int32)
+        n_drafted = {}
+        for slot in active:
+            d = drafts.get(slot.index, [])[: min(k, slot.lookahead)]
+            n_drafted[slot.index] = len(d)
+            tokens[slot.index, 0] = slot.pending
+            if d:
+                tokens[slot.index, 1:1 + len(d)] = d
+            cache_lens[slot.index] = slot.cache_len
+            lengths[slot.index] = 1 + len(d)
+        vfn = self._verify_fns.get(width)
+        if vfn is None:
+            vfn = self._verify_fns[width] = self._make_verify(width)
+        # one host-side stack -> one device put (a per-key jnp.stack
+        # would cost width+1 dispatches on the hottest loop in serving)
+        keys = np.stack(self._peek_keys(width))
+        self.cache, out = vfn(
+            self.params, self.cache, jnp.asarray(tokens),
+            self._tables_device(), jnp.asarray(cache_lens),
+            jnp.asarray(lengths), self.sampling.temperatures(),
+            jnp.asarray(keys),
+            *self._lora_call_args(self._slot_adapter),
+        )
+        out = np.asarray(out)
+        max_emitted = 1
+        for slot in active:
+            n = n_drafted[slot.index]
+            drafted = tokens[slot.index, 1:1 + n]
+            slot.cache_len += 1  # the pending token's write is always valid
+            emitted = 0
+            for j in range(n + 1):
+                token = int(out[slot.index, j])
+                accepted = j < n and token == int(drafted[j])
+                slot.pending = token
+                slot.generated.append(token)
+                emitted += 1
+                if accepted:
+                    slot.spec_accepted += 1
+                    self._spec_accepted_total += 1
+                self._note_token(slot, token, events)
+                if slot.done or not accepted:
+                    break
+                # the matched draft was written at this position by the
+                # verify pass — committing it is pure cursor advancement
+                slot.cache_len += 1
+            slot.spec_proposed += n
+            self._spec_proposed_total += n
+            max_emitted = max(max_emitted, emitted)
+            self._proposer.commit(slot)
+        self._spec_rounds_total += 1
+        self._consume_keys(max_emitted)
 
     def _note_token(self, slot: Slot, token: int,
                     events: list[TokenEvent]) -> None:
@@ -600,11 +798,20 @@ class ServingEngine:
             "decode_tokens_per_s": (
                 (n_new - 1) / decode_s if n_new > 1 and decode_s > 0 else None
             ),
+            # speculation accounting (None accept_rate = request never
+            # had a draft proposed: speculation off, or all-miss n-gram)
+            "spec_proposed": slot.spec_proposed,
+            "spec_accepted": slot.spec_accepted,
+            "accept_rate": (
+                slot.spec_accepted / slot.spec_proposed
+                if slot.spec_proposed else None
+            ),
         }
         self.stats.add(record)
         self._tele("record_serve", **record)
         span = self.span_log.on_finish(
-            req.request_id, slot.finish_time, n_new
+            req.request_id, slot.finish_time, n_new,
+            accept_rate=record["accept_rate"],
         )
         if span is not None:
             self._tele("record_span", **span.to_record())
@@ -619,7 +826,10 @@ class ServingEngine:
                 self._results.pop(self._result_order.popleft(), None)
         self.sampling.clear_slot(slot.index)
         self._tables[slot.index] = 0
+        self._tables_dev = None
         self._slot_adapter[slot.index] = 0
+        if self._proposer is not None:
+            self._proposer.release(slot.index)
         if self.adapters is not None:
             self.adapters.release(req.adapter)
         self.scheduler.release(slot)
@@ -702,6 +912,13 @@ class ServingEngine:
             ),
             "shed_queue_full_total": sched.shed_counts["queue_full"],
             "shed_queue_deadline_total": sched.shed_counts["queue_deadline"],
+            "spec_rounds": self._spec_rounds_total,
+            "spec_tokens_proposed": self._spec_proposed_total,
+            "spec_tokens_accepted": self._spec_accepted_total,
+            "spec_accept_rate": (
+                self._spec_accepted_total / self._spec_proposed_total
+                if self._spec_proposed_total else 0.0
+            ),
         }
 
     def _sample_gauges(self) -> None:
@@ -762,6 +979,40 @@ class ServingEngine:
             self.prefix_cache = None
         self.scheduler.prefix_cache = self.prefix_cache
 
+    def set_speculation(self, spec: Optional[SpecConfig]) -> None:
+        """Toggle speculative decoding at runtime on a WARM engine.
+        ``None`` (or ``k=0``) turns it off — the very next step runs the
+        plain decode program, outputs unchanged. Turning it on affects
+        only requests ADMITTED from now on (they get the +k block
+        reservation); already-seated requests finish plainly, so an
+        in-flight verify write can never outrun a reservation made
+        before the toggle. Verify programs are cached per width and
+        proposers per config instance: an off→on→off→on A/B (the serve
+        bench's speculation axis) replays warm traces — the
+        zero-retrace-after-warmup contract extends to the toggle."""
+        if spec is None or spec.k == 0:
+            self._spec = spec
+            self._proposer = None
+            self.scheduler.lookahead_tokens = 0
+            return
+        proposer = self._proposers.get(id(spec))
+        if proposer is None:
+            if spec.method == "draft_model":
+                proposer = DraftModelProposer(
+                    spec,
+                    target_config=self.model.config,
+                    num_blocks=self.num_blocks,
+                    block_size=self.block_size,
+                    max_table=self._max_table,
+                    max_slots=self.max_slots,
+                )
+            else:
+                proposer = NGramProposer(spec)
+            self._proposers[id(spec)] = proposer
+        self._spec = spec
+        self._proposer = proposer
+        self.scheduler.lookahead_tokens = spec.k
+
     def export_trace(self, path: str) -> str:
         """Write the last ``span_history`` closed spans (plus any still
         open) as Chrome-trace/Perfetto JSON; returns ``path``. Load in
@@ -821,4 +1072,17 @@ class ServingEngine:
             out["slo"] = self.slo_tracker.snapshot(self._now())
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
+        if self._proposer is not None or self._spec_rounds_total:
+            proposed = self._spec_proposed_total
+            out["speculation"] = {
+                "enabled": self._proposer is not None,
+                "method": self._spec.method if self._spec else None,
+                "k": self._spec.k if self._spec else 0,
+                "rounds": self._spec_rounds_total,
+                "proposed": proposed,
+                "accepted": self._spec_accepted_total,
+                "accept_rate": (
+                    self._spec_accepted_total / proposed if proposed else 0.0
+                ),
+            }
         return out

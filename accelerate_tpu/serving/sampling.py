@@ -48,12 +48,20 @@ class SlotSampling:
 
     def __init__(self, max_slots: int):
         self._temperature = np.zeros(max_slots, np.float32)
+        # the device copy is cached between slot changes: the decode /
+        # verify loop calls temperatures() every iteration, and a fresh
+        # host->device put per call is measurable on the CPU hot path
+        self._device: Optional[jax.Array] = None
 
     def set_slot(self, index: int, temperature: float) -> None:
         self._temperature[index] = temperature
+        self._device = None
 
     def clear_slot(self, index: int) -> None:
         self._temperature[index] = 0.0
+        self._device = None
 
     def temperatures(self) -> jax.Array:
-        return jnp.asarray(self._temperature)
+        if self._device is None:
+            self._device = jnp.asarray(self._temperature)
+        return self._device

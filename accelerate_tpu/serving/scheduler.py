@@ -96,6 +96,13 @@ class Slot:
     # table positions whose block was COW'd: private now, but partially
     # recomputed — kept out of the content index
     cow_indices: set[int] = field(default_factory=set)
+    # speculative decoding: extra tokens of block reservation granted at
+    # admission (0 = this slot decodes plainly — slots seated before
+    # speculation was toggled on have no verify headroom and stay plain)
+    lookahead: int = 0
+    # per-request speculation accounting (accept_rate at finish)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     @property
     def busy(self) -> bool:
@@ -115,6 +122,9 @@ class Slot:
         self.cached_tokens = 0
         self.cow_spare = None
         self.cow_indices = set()
+        self.lookahead = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
 
 class ContinuousScheduler:
@@ -130,6 +140,7 @@ class ContinuousScheduler:
         max_queue_delay_s: Optional[float] = None,
         adapter_ready: Optional[Callable[[Optional[str]], bool]] = None,
         prefix_cache=None,
+        max_table_blocks: Optional[int] = None,
     ):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
@@ -151,6 +162,17 @@ class ContinuousScheduler:
         # points new slots' tables at cached chain prefixes instead of
         # allocating (and later prefilling) private copies
         self.prefix_cache = prefix_cache
+        # speculative decoding: admission reserves this many EXTRA tokens
+        # of block footprint per request (the verify pass writes up to k
+        # candidate positions past the cursor before accept/reject is
+        # known, and an in-flight verify write must never OOM the pool).
+        # Set by ServingEngine.set_speculation; per-request the grant is
+        # CLAMPED to what the block table / pool can ever hold so a
+        # request that fit without speculation still admits with it on.
+        self.lookahead_tokens = 0
+        # width of the engine's per-slot block table (positions past it
+        # alias the last entry) — the lookahead clamp's second ceiling
+        self.max_table_blocks = max_table_blocks
         self.shed_counts = {"queue_full": 0, "queue_deadline": 0}
         self.blocked_reasons = {
             "no_free_slot": 0,
@@ -245,9 +267,20 @@ class ContinuousScheduler:
                 # reordering; load the adapter to unblock)
                 self.blocked_reasons["adapter_not_resident"] += 1
                 break
-            need = self.pool.blocks_for_tokens(
-                len(req.prompt) + req.max_new_tokens
-            )
+            base_tokens = len(req.prompt) + req.max_new_tokens
+            lookahead = 0
+            if self.lookahead_tokens:
+                # clamp the speculative grant to the hard ceilings (table
+                # width, allocatable pool) so a request that fit before
+                # speculation was enabled can still be seated — the head
+                # of the queue must never deadlock on un-fundable slack
+                cap = (self.pool.num_blocks - 1) * self.pool.block_size
+                if self.max_table_blocks is not None:
+                    cap = min(cap, self.max_table_blocks * self.pool.block_size)
+                lookahead = max(
+                    0, min(self.lookahead_tokens, cap - base_tokens)
+                )
+            need = self.pool.blocks_for_tokens(base_tokens + lookahead)
             shared: list[int] = []
             if self.prefix_cache is not None:
                 if req.prefix_keys is None:
@@ -277,6 +310,7 @@ class ContinuousScheduler:
             slot.blocks = shared + self.pool.allocate(need - len(shared))
             slot.shared = set(range(len(shared)))
             slot.cached_tokens = cached_tokens
+            slot.lookahead = lookahead
             if cow_reserve:
                 slot.cow_spare = self.pool.allocate(1)[0]
             slot.admit_time = self._now()

@@ -51,6 +51,9 @@ class RequestSpan:
     # prefix caching: prompt tokens whose KV came from the shared cache
     # (prefill skipped them) — 0 for cold requests / caching off
     cached_prefix_tokens: int = 0
+    # speculative decoding: accepted/proposed draft tokens over the
+    # request's life (None = no drafts were ever proposed for it)
+    accept_rate: Optional[float] = None
 
     @property
     def terminal(self) -> bool:
@@ -85,6 +88,7 @@ class RequestSpan:
             "prompt_tokens": self.prompt_tokens,
             "cached_prefix_tokens": self.cached_prefix_tokens,
             "new_tokens": self.new_tokens,
+            "accept_rate": self.accept_rate,
             "submit_t": self.submit_t,
             "admit_t": self.admit_t,
             "prefill_start_t": self.prefill_start_t,
@@ -159,8 +163,12 @@ class SpanLog:
         return span
 
     def on_finish(
-        self, request_id: str, t: float, new_tokens: int
+        self, request_id: str, t: float, new_tokens: int,
+        accept_rate: Optional[float] = None,
     ) -> Optional[RequestSpan]:
+        span = self._open.get(request_id)
+        if span is not None:
+            span.accept_rate = accept_rate
         return self._close(request_id, t, "finished", None, new_tokens)
 
     def on_shed(

@@ -85,10 +85,17 @@ ServingEngine at slot retirement)::
     decode_tokens_per_s  float? steady-state decode rate for THIS request
                                 (excludes the prefill token; null for
                                 single-token generations)
+    spec_proposed        int    speculative draft tokens proposed for the
+                                request (0 when speculation is off)
+    spec_accepted        int    drafts the target-model verify accepted
+    accept_rate          float? spec_accepted / spec_proposed (null when
+                                nothing was proposed)
 
-    The Prometheus sink exports the four latency fields as summaries —
-    rolling-window p50/p95/p99 quantile lines plus cumulative _count and
-    _sum — instead of last-value gauges.
+    The Prometheus sink exports the latency fields and accept_rate as
+    summaries — rolling-window p50/p95/p99 quantile lines plus
+    cumulative _count and _sum — instead of last-value gauges, and the
+    speculation tallies as per-tenant counters
+    ``{prefix}_serve_spec_{proposed,accepted}_total{adapter="..."}``.
 
 ``kind="span"`` (one per request reaching a TERMINAL state — finished or
 shed; emitted by the ServingEngine's span log)::
@@ -100,6 +107,8 @@ shed; emitted by the ServingEngine's span log)::
     cached_prefix_tokens int prompt tokens served from the prefix cache
                            (prefill skipped them; 0 when caching is off)
     new_tokens       int    tokens generated (0 for shed requests)
+    accept_rate      float? speculative-draft accept rate over the
+                           request's life (null when none proposed)
     submit_t         float  engine-clock (monotonic) lifecycle stamps;
     admit_t          float? null where the span never reached the edge
     prefill_start_t  float?
@@ -137,6 +146,10 @@ shed; emitted by the ServingEngine's span log)::
     admission_blocked_pool_exhausted_total int  admit() stalls: pool empty
     shed_queue_full_total                int    cumulative sheds per reason
     shed_queue_deadline_total            int
+    spec_rounds                          int    speculative verify rounds run
+    spec_tokens_proposed                 int    cumulative drafts proposed
+    spec_tokens_accepted                 int    cumulative drafts accepted
+    spec_accept_rate                     float  lifetime accepted / proposed
 
 ``kind="shed"`` (one per request refused/evicted under overload; the
 Prometheus sink counts these as
@@ -274,6 +287,19 @@ _SERVE_SUMMARY_FIELDS = {
     "e2e_s": "serve_e2e_seconds",
     "queue_s": "serve_queue_seconds",
     "decode_tokens_per_s": "serve_decode_tokens_per_second",
+    # speculative decoding: per-request draft accept rate (absent from
+    # the record when no drafts were proposed, so the summary only
+    # aggregates requests speculation actually touched)
+    "accept_rate": "serve_spec_accept_rate",
+}
+
+# serve-record speculation tallies exported as per-tenant COUNTERS
+# ({prefix}_serve_spec_{proposed,accepted}_total) — a last-value gauge
+# of a per-request count is meaningless; the monotonic totals are what
+# rate() wants
+_SERVE_SPEC_COUNTER_FIELDS = {
+    "spec_proposed": "serve_spec_proposed_total",
+    "spec_accepted": "serve_spec_accepted_total",
 }
 
 _SERVE_QUANTILES = (0.5, 0.95, 0.99)
@@ -388,6 +414,14 @@ class PrometheusTextSink(TelemetrySink):
         self._counters[ckey] = self._counters.get(ckey, 0.0) + 1.0
         for key, value in record.items():
             if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            counter = _SERVE_SPEC_COUNTER_FIELDS.get(key)
+            if counter is not None:
+                if value:
+                    sckey = (f"{self.prefix}_{counter}", "adapter", adapter)
+                    self._counters[sckey] = (
+                        self._counters.get(sckey, 0.0) + float(value)
+                    )
                 continue
             name = _SERVE_SUMMARY_FIELDS.get(key)
             if name is not None:

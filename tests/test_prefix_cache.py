@@ -371,6 +371,36 @@ def test_set_prefix_cache_toggles_on_warm_engine_without_retrace(tiny_model):
     assert _drain(engine, template + [5]) == cold
 
 
+def test_speculation_on_warm_prefix_matches_cold_and_cows(tiny_model):
+    """Prefix caching x speculative decoding: a warm full-prompt hit
+    seats the request ON the shared chain, and the verify pass writes up
+    to k positions past the cursor — the engine must copy the shared
+    block private BEFORE any speculative write (a rejected draft's KV
+    landing in a published block would corrupt every other holder).
+    Outputs stay bitwise equal to a cold spec-off engine throughout."""
+    from accelerate_tpu.serving import SpecConfig
+
+    cfg, model, params = tiny_model
+    template = list(range(1, 13))  # 3 full blocks of 4
+    cold = ServingEngine(model, params, max_slots=2, block_size=4, seed=4)
+    want = _drain(cold, template, max_new=8)
+    engine = ServingEngine(
+        model, params, max_slots=2, block_size=4, seed=4,
+        prefix_cache=True, spec_decode=SpecConfig(k=3),
+    )
+    assert _drain(engine, template, max_new=8) == want  # publishes
+    before = engine.prefix_cache.cow_copies_total
+    assert _drain(engine, template, max_new=8) == want  # full hit
+    # the speculative write span crossed into the shared last block:
+    # exactly one private copy, made before verify touched it
+    assert engine.prefix_cache.cow_copies_total == before + 1
+    # donor chain intact: a third identical request still hits it
+    assert _drain(engine, template, max_new=8) == want
+    assert engine.prefix_cache.stats()["hits"] == 2
+    spec = engine.summary()["speculation"]
+    assert spec["rounds"] > 0  # the speculative path really ran
+
+
 def test_pool_exhaustion_rolls_back_acquired_prefix(tiny_model):
     """If the pool can't fund a request's UNCACHED remainder, admission
     must release the chain it just pinned (no leaked refcounts)."""

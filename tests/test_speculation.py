@@ -1,0 +1,457 @@
+"""Speculative decoding: propose-k / verify-once / commit-the-match.
+
+Five layers, matching the feature's split: config validation, the
+host-side n-gram lookup (pure numpy, no device work), the engine's
+verify/commit loop (token-for-token parity with plain decode and with
+the dense-cache ``generate`` path — speculation must change WHEN tokens
+are computed, never WHICH), the draft-model proposer's shared-block-
+table cache discipline, and the contracts that make it servable: +k
+block reservation at admit, zero verify retraces after warmup, warm
+on/off toggling, COW before any speculative write into a shared block.
+"""
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.models import CausalLM, TransformerConfig
+from accelerate_tpu.models.generation import generate
+from accelerate_tpu.serving import (
+    BlockPool,
+    ContinuousScheduler,
+    NGramProposer,
+    Request,
+    ServingEngine,
+    SpecConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    return cfg, model, params
+
+
+@pytest.fixture(scope="module")
+def draft_pair():
+    """A self-consistent target/draft pair: the target's upper layers
+    are residual no-ops (attention + MLP output projections zeroed, so
+    they add exact zeros to the residual stream) and the 1-layer draft
+    holds the target's bottom layer, embedding and head. Their logits
+    agree BITWISE — the draft predicts the target perfectly, which pins
+    accept_rate == 1.0 deterministically without training anything."""
+    cfg = TransformerConfig.tiny(max_seq_len=64, num_layers=3)
+    target = CausalLM(cfg)
+    params = target.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    for block, proj in (("attn", "o_proj"), ("mlp", "down_proj")):
+        params["layers"][block][proj] = jax.tree_util.tree_map(
+            lambda x: x.at[1:].set(0.0), params["layers"][block][proj]
+        )
+    draft = CausalLM(replace(cfg, num_layers=1))
+    draft_params = dict(params)
+    draft_params["layers"] = jax.tree_util.tree_map(
+        lambda x: x[:1], params["layers"]
+    )
+    return cfg, target, params, draft, draft_params
+
+
+def _drain(engine, prompts, max_new=8, temperature=0.0):
+    rids = [
+        engine.add_request(
+            list(p), max_new_tokens=max_new, temperature=temperature
+        )
+        for p in prompts
+    ]
+    for _ in engine.stream():
+        pass
+    return [engine.result(r) for r in rids]
+
+
+# ---------------------------------------------------------------------- #
+# config validation
+# ---------------------------------------------------------------------- #
+def test_spec_config_validates():
+    with pytest.raises(ValueError, match="k must be >= 0"):
+        SpecConfig(k=-1)
+    with pytest.raises(ValueError, match="method"):
+        SpecConfig(method="medusa")
+    with pytest.raises(ValueError, match="draft_model"):
+        SpecConfig(method="draft_model")  # no draft supplied
+    with pytest.raises(ValueError, match="min_ngram"):
+        SpecConfig(min_ngram=3, max_ngram=2)
+    # k=0 disables speculation — valid with either method, no draft
+    # required (nothing will ever be proposed)
+    assert SpecConfig(k=0).k == 0
+    assert SpecConfig(k=0, method="draft_model").method == "draft_model"
+
+
+def test_draft_proposer_rejects_mismatched_configs(tiny_model):
+    cfg, model, params = tiny_model
+    bad_vocab = CausalLM(replace(cfg, vocab_size=cfg.vocab_size * 2))
+    with pytest.raises(ValueError, match="vocab"):
+        ServingEngine(
+            model, params, max_slots=2, block_size=4,
+            spec_decode=SpecConfig(
+                k=2, method="draft_model",
+                draft_model=bad_vocab, draft_params=params,
+            ),
+        )
+    short = CausalLM(replace(cfg, max_seq_len=cfg.max_seq_len // 2))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        ServingEngine(
+            model, params, max_slots=2, block_size=4,
+            spec_decode=SpecConfig(
+                k=2, method="draft_model",
+                draft_model=short, draft_params=params,
+            ),
+        )
+
+
+# ---------------------------------------------------------------------- #
+# n-gram lookup (host-side, no device work)
+# ---------------------------------------------------------------------- #
+def test_ngram_lookup_proposes_continuation_of_trailing_ngram():
+    p = NGramProposer(SpecConfig(k=3))
+    #       [1 2 3 4] ... [3 4] -> tokens after the earlier [3 4]
+    assert p.lookup([1, 2, 3, 4, 9, 8, 3, 4], 3) == [9, 8, 3]
+
+
+def test_ngram_lookup_prefers_longest_then_most_recent():
+    p = NGramProposer(SpecConfig(k=2, max_ngram=2))
+    # trailing [5, 6]: bigram matches at position 0 AND position 3 —
+    # the MOST RECENT earlier occurrence (followed by 7) must win over
+    # the older one (followed by 9)
+    assert p.lookup([5, 6, 9, 5, 6, 7, 5, 6], 2) == [7, 5]
+    # trailing unigram [6] would match too, but the bigram is preferred
+    q = NGramProposer(SpecConfig(k=1, max_ngram=2))
+    assert q.lookup([6, 1, 5, 6, 2, 5, 6], 1) == [2]
+
+
+def test_ngram_lookup_miss_and_degenerate_inputs():
+    p = NGramProposer(SpecConfig(k=4))
+    assert p.lookup([1, 2, 3, 4, 5], 4) == []  # no repeats anywhere
+    assert p.misses == 1
+    assert p.lookup([7], 4) == []      # too short for any n-gram + follow
+    assert p.lookup([1, 2, 1, 2], 0) == []  # k = 0 proposes nothing
+
+
+# ---------------------------------------------------------------------- #
+# parity: speculation must never change the emitted stream
+# ---------------------------------------------------------------------- #
+def test_k0_and_spec_none_match_plain_engine_and_generate(tiny_model):
+    """``spec_decode=SpecConfig(k=0)`` (and None) is bit-for-bit the
+    plain engine, which itself matches the dense-cache ``generate``
+    path — the whole chain pinned in one place."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(1, cfg.vocab_size, 5)) for _ in range(3)]
+    plain = ServingEngine(model, params, max_slots=2, block_size=4, seed=2)
+    k0 = ServingEngine(
+        model, params, max_slots=2, block_size=4, seed=2,
+        spec_decode=SpecConfig(k=0),
+    )
+    want = _drain(plain, prompts)
+    assert _drain(k0, prompts) == want
+    dense = generate(
+        model, params, jnp.asarray([prompts[0]], jnp.int32),
+        max_new_tokens=8,
+    )
+    assert list(np.asarray(dense)[0, len(prompts[0]):]) == want[0]
+
+
+def test_k0_parity_holds_under_sampling(tiny_model):
+    """temperature > 0: the sampler key stream advances per EMITTED
+    token, so a k=0 spec engine consumes keys exactly like the plain
+    engine — sampled outputs are identical, not just greedy ones."""
+    cfg, model, params = tiny_model
+    prompts = [[1, 2, 3, 4, 5]]
+    plain = ServingEngine(model, params, max_slots=2, block_size=4, seed=5)
+    k0 = ServingEngine(
+        model, params, max_slots=2, block_size=4, seed=5,
+        spec_decode=SpecConfig(k=0),
+    )
+    assert (
+        _drain(k0, prompts, temperature=0.9)
+        == _drain(plain, prompts, temperature=0.9)
+    )
+
+
+def test_greedy_ngram_speculation_matches_plain_engine(tiny_model):
+    """Repetitive prompts (n-gram's home turf) with multi-slot churn:
+    spec-on greedy output must equal spec-off token for token, with a
+    nonzero accept rate proving the speculative path actually ran."""
+    cfg, model, params = tiny_model
+    prompts = [[7, 8, 9] * 4, [3, 4] * 5, [5, 6, 5, 6, 5, 6]]
+    off = ServingEngine(model, params, max_slots=2, block_size=4, seed=0)
+    on = ServingEngine(
+        model, params, max_slots=2, block_size=4, seed=0,
+        spec_decode=SpecConfig(k=3),
+    )
+    want = _drain(off, prompts, max_new=12)
+    assert _drain(on, prompts, max_new=12) == want
+    spec = on.summary()["speculation"]
+    assert spec["rounds"] > 0 and spec["proposed"] > 0
+
+
+def test_single_slot_sampled_speculation_matches_plain_engine(tiny_model):
+    """temperature > 0, one slot: the verify pass samples the TARGET
+    with the same chain keys plain decode would use, so even sampled
+    streams agree exactly (multi-slot sampled traffic can't — slots
+    would race for positions in the shared key chain)."""
+    cfg, model, params = tiny_model
+    prompts = [[2, 3] * 6]
+    off = ServingEngine(model, params, max_slots=1, block_size=4, seed=11)
+    on = ServingEngine(
+        model, params, max_slots=1, block_size=4, seed=11,
+        spec_decode=SpecConfig(k=3),
+    )
+    want = _drain(off, prompts, max_new=12, temperature=0.8)
+    assert _drain(on, prompts, max_new=12, temperature=0.8) == want
+
+
+def test_bad_draft_model_only_lowers_accept_rate(tiny_model):
+    """A draft with the right shapes but DIFFERENT weights: outputs must
+    still equal the plain engine's (verification filters every wrong
+    guess) — proposer quality is a throughput knob, never correctness."""
+    cfg, model, params = tiny_model
+    bad_params = model.init(
+        jax.random.PRNGKey(99), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(4)
+    prompts = [list(rng.integers(1, cfg.vocab_size, 6)) for _ in range(2)]
+    off = ServingEngine(model, params, max_slots=2, block_size=4, seed=0)
+    on = ServingEngine(
+        model, params, max_slots=2, block_size=4, seed=0,
+        spec_decode=SpecConfig(
+            k=3, method="draft_model",
+            draft_model=model, draft_params=bad_params,
+        ),
+    )
+    assert _drain(on, prompts, max_new=10) == _drain(off, prompts, max_new=10)
+
+
+# ---------------------------------------------------------------------- #
+# draft-model proposer: the self-consistent pair
+# ---------------------------------------------------------------------- #
+def test_perfect_draft_accepts_everything(draft_pair):
+    cfg, target, params, draft, draft_params = draft_pair
+    off = ServingEngine(target, params, max_slots=2, block_size=4, seed=0)
+    on = ServingEngine(
+        target, params, max_slots=2, block_size=4, seed=0,
+        spec_decode=SpecConfig(
+            k=4, method="draft_model",
+            draft_model=draft, draft_params=draft_params,
+        ),
+    )
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+    want = _drain(off, prompts, max_new=20)
+    assert _drain(on, prompts, max_new=20) == want
+    spec = on.summary()["speculation"]
+    assert spec["accept_rate"] == 1.0
+    # every round emitted k+1 tokens per live slot: far fewer verify
+    # rounds than the 20 tokens a request emits — the one-token-per-step
+    # wall is actually broken (19 post-prefill tokens / 5 per round)
+    assert 0 < spec["rounds"] <= 8
+
+
+def test_draft_cache_follows_engine_block_tables(draft_pair):
+    """Slot churn (retire + re-admit onto RECYCLED blocks) with the
+    draft proposer attached: the draft's paged cache is addressed by the
+    engine's tables, so stale draft KV from a previous tenant of the
+    same block must never leak into proposals. Parity across churn
+    proves the prefill_slot/commit/release bookkeeping."""
+    cfg, target, params, draft, draft_params = draft_pair
+    rng = np.random.default_rng(8)
+    prompts = [list(rng.integers(1, cfg.vocab_size, 5)) for _ in range(5)]
+    off = ServingEngine(target, params, max_slots=2, block_size=4, seed=0)
+    on = ServingEngine(
+        target, params, max_slots=2, block_size=4, seed=0,
+        spec_decode=SpecConfig(
+            k=3, method="draft_model",
+            draft_model=draft, draft_params=draft_params,
+        ),
+    )
+    assert _drain(on, prompts, max_new=10) == _drain(off, prompts, max_new=10)
+
+
+# ---------------------------------------------------------------------- #
+# serving contracts: reservation, retrace, toggle, COW
+# ---------------------------------------------------------------------- #
+def test_admit_reserves_k_lookahead_blocks():
+    pool = BlockPool(num_blocks=9, block_size=4)  # 8 allocatable
+    sched = ContinuousScheduler(max_slots=2, pool=pool)
+    sched.lookahead_tokens = 4
+    sched.submit(Request(prompt=[1] * 4, max_new_tokens=4))
+    slot = sched.admit()[0]
+    # 4 prompt + 4 new + 4 lookahead = 12 tokens -> 3 blocks, not the 2
+    # a non-speculating admit would take: verify writes k positions past
+    # the cursor, and that span must be funded up front
+    assert len(slot.blocks) == 3
+    assert slot.lookahead == 4
+
+
+def test_lookahead_clamps_at_table_capacity():
+    """A request whose base need already fills the block table still
+    admits — lookahead shrinks instead of deadlocking the queue head."""
+    pool = BlockPool(num_blocks=17, block_size=4)
+    sched = ContinuousScheduler(
+        max_slots=1, pool=pool, max_table_blocks=4
+    )
+    sched.lookahead_tokens = 8
+    sched.submit(Request(prompt=[1] * 8, max_new_tokens=8))  # 16 = cap
+    slot = sched.admit()[0]
+    assert len(slot.blocks) == 4
+    assert slot.lookahead == 0  # no headroom left for speculation
+
+
+def test_verify_traces_once_and_toggle_is_retrace_free(tiny_model):
+    """The zero-retrace contract extends to speculation: one verify
+    program per width, and an off->on->off->on toggle replays warm
+    traces. k=0 rounds fall back to the SAME decode program."""
+    cfg, model, params = tiny_model
+    spec = SpecConfig(k=3)
+    engine = ServingEngine(
+        model, params, max_slots=2, block_size=4, seed=0, spec_decode=spec
+    )
+    prompts = [[7, 8] * 5, [1, 2, 3] * 3]
+    want = _drain(engine, prompts, max_new=10)   # compiles verify widths
+    assert engine.trace_counts()["verify"] >= 1
+    engine.set_speculation(None)          # off: plain decode path
+    assert _drain(engine, prompts, max_new=10) == want
+    warm = engine.trace_counts()          # every program now compiled
+    engine.set_speculation(spec)          # back on: cached proposer
+    assert _drain(engine, prompts, max_new=10) == want
+    engine.set_speculation(None)
+    assert _drain(engine, prompts, max_new=10) == want
+    # the deterministic replay hit only warm programs — the zero-retrace
+    # contract survives the toggle in both directions
+    assert engine.trace_counts() == warm
+    assert warm["decode"] == 1  # ONE decode program across all of it
+
+
+def test_speculative_write_into_shared_block_cows_first(tiny_model):
+    """A shared (prefix-cached) block inside the speculative write span
+    must be copied-on-write BEFORE the verify pass touches it — verify
+    writes up to k positions past the cursor, and a rejected draft's
+    write into a shared block would corrupt every other holder."""
+    cfg, model, params = tiny_model
+    engine = ServingEngine(
+        model, params, max_slots=1, block_size=4, seed=0,
+        prefix_cache=True, spec_decode=SpecConfig(k=3),
+    )
+    template = list(range(1, 13))  # 3 full blocks of 4
+    _drain(engine, [template], max_new=6)        # publishes the chain
+    before = engine.prefix_cache.cow_copies_total
+    out = _drain(engine, [template], max_new=6)  # full hit -> shares
+    assert engine.prefix_cache.cow_copies_total > before
+    cold = ServingEngine(model, params, max_slots=1, block_size=4, seed=0)
+    assert _drain(cold, [template], max_new=6) == out
+
+
+def test_spec_observability_records_counters_and_diagnose(
+    draft_pair, tmp_path
+):
+    """accept_rate rides the full observability stack: per-request
+    serve records + spans, per-tenant Prometheus counters, engine
+    gauges, and the diagnose report line."""
+    from accelerate_tpu.diagnostics import build_report, format_report
+    from accelerate_tpu.telemetry import (
+        PrometheusTextSink,
+        StepTelemetry,
+        TelemetryConfig,
+    )
+
+    cfg, target, params, draft, draft_params = draft_pair
+    diag_dir = str(tmp_path / "diag")
+    tele = StepTelemetry(TelemetryConfig(diagnostics=diag_dir))
+    prom = PrometheusTextSink(path=None)
+    tele.add_sink(prom)
+    engine = ServingEngine(
+        target, params, max_slots=2, block_size=4, seed=0, telemetry=tele,
+        spec_decode=SpecConfig(
+            k=4, method="draft_model",
+            draft_model=draft, draft_params=draft_params,
+        ),
+    )
+    # 16 new tokens = prefill token + exactly three full k=4 rounds, so
+    # no round is cut short by ``done`` and every proposal is accepted
+    _drain(engine, [[3, 1, 4, 1, 5]], max_new=16)
+    rec = next(r for r in tele.records if r.get("kind") == "serve")
+    assert rec["spec_proposed"] > 0
+    assert rec["spec_accepted"] == rec["spec_proposed"]
+    assert rec["accept_rate"] == 1.0
+    span = next(r for r in tele.records if r.get("kind") == "span")
+    assert span["accept_rate"] == 1.0
+    gauges = engine._gauge_fields()
+    assert gauges["spec_accept_rate"] == 1.0
+    assert gauges["spec_rounds"] == engine.summary()["speculation"]["rounds"]
+    text = prom.render()
+    assert "accelerate_tpu_serve_spec_proposed_total" in text
+    assert "accelerate_tpu_serve_spec_accepted_total" in text
+    assert "accelerate_tpu_serve_spec_accept_rate" in text
+    tele.close()  # flight dump for diagnose
+    report_text = format_report(build_report(diag_dir))
+    assert "speculation:" in report_text
+    assert "accept_rate=100.0%" in report_text
+
+
+# ---------------------------------------------------------------------- #
+# the spec-smoke acceptance scenario (make spec-smoke)
+# ---------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_spec_smoke_end_to_end(draft_pair):
+    """The ~30s CPU acceptance pass: k=0 parity, perfect-draft greedy
+    parity at accept_rate 1.0, zero verify retraces across a toggle,
+    and COW-before-speculative-write — the four contracts that make
+    speculation shippable, in one scenario."""
+    cfg, target, params, draft, draft_params = draft_pair
+    rng = np.random.default_rng(6)
+    prompts = [list(rng.integers(1, cfg.vocab_size, 5)) for _ in range(4)]
+    spec = SpecConfig(
+        k=4, method="draft_model",
+        draft_model=draft, draft_params=draft_params,
+    )
+    off = ServingEngine(target, params, max_slots=2, block_size=4, seed=0)
+    want = _drain(off, prompts, max_new=16)
+    k0 = ServingEngine(
+        target, params, max_slots=2, block_size=4, seed=0,
+        spec_decode=SpecConfig(k=0),
+    )
+    assert _drain(k0, prompts, max_new=16) == want
+    on = ServingEngine(
+        target, params, max_slots=2, block_size=4, seed=0,
+        prefix_cache=True, spec_decode=spec,
+    )
+    assert _drain(on, prompts, max_new=16) == want
+    assert on.trace_counts()["verify"] == 1
+    spec_sum = on.summary()["speculation"]
+    assert spec_sum["accept_rate"] == 1.0
+    # warm replay across a toggle: same outputs, zero new programs
+    # (the off arm compiles the plain decode program once, then the
+    # second on/off cycle must hit only warm traces)
+    on.set_speculation(None)
+    assert _drain(on, prompts, max_new=16) == want
+    warm = on.trace_counts()
+    on.set_speculation(spec)
+    assert _drain(on, prompts, max_new=16) == want
+    on.set_speculation(None)
+    assert _drain(on, prompts, max_new=16) == want
+    on.set_speculation(spec)
+    assert on.trace_counts() == warm
+    # COW guards the speculative span on a shared chain
+    template = list(range(1, 13))
+    _drain(on, [template], max_new=6)
+    before = on.prefix_cache.cow_copies_total
+    shared_out = _drain(on, [template], max_new=6)
+    assert on.prefix_cache.cow_copies_total > before
+    cold = ServingEngine(target, params, max_slots=1, block_size=4, seed=0)
+    assert _drain(cold, [template], max_new=6) == shared_out
