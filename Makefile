@@ -33,11 +33,12 @@ FAST_FILES = \
   tests/test_serving.py tests/test_serving_obs.py \
   tests/test_elastic.py tests/test_fused_kernels.py \
   tests/test_slice_mesh.py tests/test_adapters.py \
-  tests/test_prefix_cache.py tests/test_speculation.py
+  tests/test_prefix_cache.py tests/test_speculation.py \
+  tests/test_profiling.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
   diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke \
-  slice-smoke kernels-smoke lora-smoke prefix-smoke spec-smoke
+  slice-smoke kernels-smoke lora-smoke prefix-smoke spec-smoke mem-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -178,6 +179,17 @@ lora-smoke:
 	JAX_PLATFORMS=cpu $(PYTEST) -q \
 	  tests/test_adapters.py::test_multi_adapter_batch_bitwise_matches_single_tenant \
 	  tests/test_adapters.py::test_lora_smoke_end_to_end
+
+# memory & attribution acceptance on CPU (~20s): AOT warmup registers the
+# real unified_step's compiled program (the ledger sums), the live-buffer
+# census attributes the warmed carry to params/opt owners with owners +
+# unowned summing to total live bytes, and a synthetic RESOURCE_EXHAUSTED
+# in a subprocess leaves a parseable oom-report.json autopsy behind
+mem-smoke:
+	JAX_PLATFORMS=cpu $(PYTEST) -q \
+	  tests/test_profiling.py::test_warmup_registers_program_and_ledger_sums \
+	  tests/test_profiling.py::test_census_owner_attribution_on_warmed_step \
+	  tests/test_profiling.py::test_oom_autopsy_survives_crashing_subprocess
 
 # diagnostics end-to-end on CPU: a tiny train loop with an injected slow
 # step and an injected NaN gradient runs with the flight recorder on,

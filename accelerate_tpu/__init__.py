@@ -45,6 +45,14 @@ from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import LocalSGD
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
+from .profiling import (
+    BufferCensus,
+    ProgramRegistry,
+    get_program_registry,
+    read_oom_report,
+    reset_program_registry,
+    write_oom_report,
+)
 from .scheduler import AcceleratedScheduler
 from .serving import SLOConfig, ServingEngine, TokenEvent
 from .state import AcceleratorState, GradientState, PartialState
@@ -128,6 +136,12 @@ __all__ = [
     "ServingEngine",
     "SLOConfig",
     "TokenEvent",
+    "ProgramRegistry",
+    "BufferCensus",
+    "get_program_registry",
+    "reset_program_registry",
+    "write_oom_report",
+    "read_oom_report",
     "AdapterRegistry",
     "LoraConfig",
     "init_adapter",

@@ -925,6 +925,20 @@ class Accelerator:
         static_names = tuple(self.compile_plugin.static_argnames)
         mon = get_compile_monitor()
         aot: dict[tuple, Any] = {}  # (fingerprint, statics) -> Compiled
+        # the census attributes HBM by re-traversing the LATEST carry at
+        # sample time (donation replaces buffers every step, so captured
+        # ids go stale); the step fn refreshes this stash in O(1)
+        carry_stash: dict[str, Any] = {"carry": None}
+        census = getattr(self.telemetry, "census", None)
+        if census is not None:
+            def _carry_part(key: str):
+                def provider():
+                    carry = carry_stash["carry"]
+                    return carry.get(key) if isinstance(carry, dict) else None
+                return provider
+
+            for owner in ("params", "opt_state", "accum_grads"):
+                census.set_owner(owner, _carry_part(owner))
 
         def _aot_key(args, kw) -> tuple:
             # statics select the traced program, so they key the executable
@@ -944,24 +958,34 @@ class Accelerator:
                 retraced = tel.detector(tel_label).check(*args, kw)
             compiled = aot.get(_aot_key(args, kw)) if aot else None
             before = mon.snapshot() if observing else None
-            with mon.label(tel_label):
-                if compiled is not None:
-                    try:
-                        dyn_kw = {
-                            k: v for k, v in kw.items() if k not in static_names
-                        }
-                        out = compiled(*args, **dyn_kw)
-                    except Exception:
-                        # donated args are consumed only on successful
-                        # dispatch, so the jitted retry sees live buffers
-                        logger.warning(
-                            "AOT executable for %s rejected the call; "
-                            "falling back to jit dispatch", tel_label,
-                        )
-                        aot.clear()
+            try:
+                with mon.label(tel_label):
+                    if compiled is not None:
+                        try:
+                            dyn_kw = {
+                                k: v
+                                for k, v in kw.items()
+                                if k not in static_names
+                            }
+                            out = compiled(*args, **dyn_kw)
+                        except Exception:
+                            # donated args are consumed only on successful
+                            # dispatch, so the jitted retry sees live buffers
+                            logger.warning(
+                                "AOT executable for %s rejected the call; "
+                                "falling back to jit dispatch", tel_label,
+                            )
+                            aot.clear()
+                            out = jitted(*args, **kw)
+                    else:
                         out = jitted(*args, **kw)
-                else:
-                    out = jitted(*args, **kw)
+            except Exception as exc:
+                # device OOM: write the autopsy from what is already in
+                # memory, then let the original error propagate
+                self._handle_oom(exc, context=f"train_step:{tel_label}")
+                raise
+            if isinstance(out, tuple) and out:
+                carry_stash["carry"] = out[0]
             # Host mirrors, no device sync: the micro/opt progression is
             # deterministic from the call count (overflow skips hold params
             # but still advance the counters), so accelerator.step,
@@ -1018,6 +1042,15 @@ class Accelerator:
             warm_kw = dict(static_kw)
             warm_kw.update(spec_like(traced_kw))
             aot[_aot_key(specs, warm_kw)] = compiled
+            # the warmup path holds the Compiled in hand, so program
+            # registration (memory_analysis / cost_analysis ledger +
+            # roofline) is free here — no extra lowering or compile
+            from .profiling.registry import get_program_registry
+
+            get_program_registry().register_compiled(
+                tel_label, compiled, kind="train", compile_seconds=seconds,
+                microbatches=microbatches, dispatches=dispatches,
+            )
             # pre-seed the retrace detector: the first real step with
             # these shapes is a warm cache hit, not a (re)trace
             self.telemetry.detector(tel_label).check(*specs, warm_kw)
@@ -1037,6 +1070,38 @@ class Accelerator:
         step_fn.warm = warm
         step_fn.label = tel_label
         return step_fn
+
+    def _handle_oom(
+        self, exc: BaseException, *, context: str, pool_stats=None,
+    ):
+        """RESOURCE_EXHAUSTED boundary handler: write the atomic
+        ``oom-report.json`` autopsy (ledger + last census + top programs,
+        all already in memory) and force a flight-recorder dump, then
+        return so the caller can re-raise. Any other exception is a
+        no-op. Never raises — forensics must not mask the real error."""
+        try:
+            from .profiling.oom import is_resource_exhausted, write_oom_report
+
+            if not is_resource_exhausted(exc):
+                return None
+            census = getattr(self.telemetry, "census", None)
+            diag = self.telemetry.diagnostics
+            directory = diag.config.dir if diag is not None else None
+            path = write_oom_report(
+                exc,
+                context=context,
+                census=census.last if census is not None else None,
+                pool_stats=pool_stats,
+                directory=directory,
+            )
+            if diag is not None:
+                diag.recorder.event(
+                    "oom", context=context, report_path=path,
+                    error=str(exc)[:500],
+                )
+            return path
+        except Exception:  # noqa: BLE001
+            return None
 
     def warmup(self, step_fn: Callable, *args, **kw) -> dict:
         """Ahead-of-time compile a built step fn: derive abstract specs

@@ -29,7 +29,7 @@ from typing import Optional
 
 from .partial import ENV_PARTIAL_DIR, PartialWriter, partial_path
 from .registry import build_registry
-from .runner import BenchRunner, SubprocessLauncher
+from .runner import BenchRunner, SubprocessLauncher, load_baseline
 from .scheduler import (
     ENV_DEADLINE,
     Deadline,
@@ -71,6 +71,10 @@ def _parser() -> argparse.ArgumentParser:
                         f"(env {ENV_DEADLINE})")
     p.add_argument("--list", action="store_true",
                    help="print the registry (names, priorities, groups)")
+    p.add_argument("--baseline", default=None,
+                   help="previous BENCH_*.json (or raw JSON-lines output) "
+                        "to stamp prev_*/regression trend fields against; "
+                        "default: the newest BENCH_*.json in the cwd")
     p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--budget", type=float, default=None,
                    help=argparse.SUPPRESS)
@@ -120,6 +124,17 @@ def _run_child(names: list[str], budget_s: Optional[float],
         try:
             rec = result_line(variant, partial=writer)
         except Exception as exc:  # noqa: BLE001 — isolate group members
+            from accelerate_tpu.profiling.oom import (
+                is_resource_exhausted,
+                write_oom_report,
+            )
+
+            if is_resource_exhausted(exc):
+                # the autopsy lands next to the partial snapshots, where
+                # the parent harvests it (expected-OOM variants included)
+                write_oom_report(
+                    exc, context=f"bench:{name}", directory=partial_dir,
+                )
             print(f"variant {name} failed: {exc!r}",
                   file=sys.stderr, flush=True)
             rc = 1
@@ -218,5 +233,6 @@ def main(argv: Optional[list[str]] = None) -> int:
         partial_dir=partial_dir,
         settle_s=60.0 if on_tpu else 5.0,
         on_tpu=on_tpu,
+        baseline=load_baseline(args.baseline),
     )
     return runner.run()

@@ -107,6 +107,73 @@ def _oom_line(err: str) -> Optional[str]:
     )
 
 
+#: units where a SMALLER value is the better measurement (times,
+#: latencies, overhead percentages); every other unit (tokens/s,
+#: tokens/s/chip, speedup "x") improves upward
+_LOWER_IS_BETTER_UNITS = frozenset({"s", "ms", "s/token", "%", "pct"})
+
+
+def parse_baseline_records(text: str) -> dict[str, dict]:
+    """Parse one prior bench output into ``{variant: record}``.
+
+    Accepts either the driver's ``BENCH_*.json`` wrapper (``{"n", "cmd",
+    "rc", "tail"}`` where ``tail`` holds the JSON-lines stream) or a raw
+    JSON-lines stream. The stream prints every record twice on a clean
+    run — provisionally at land time, finally in the consolidated block
+    — so the LAST line per variant wins and final records (no
+    ``provisional`` flag) displace provisional ones."""
+    meta: dict = {}
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict) and "tail" in obj:
+        meta = {"prev_round": obj.get("n")}
+        text = obj.get("tail") or ""
+    provisional: dict[str, dict] = {}
+    final: dict[str, dict] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        name = rec.get("variant")
+        if not name or rec.get("skipped") or rec.get("value") is None:
+            continue
+        rec.update(meta)
+        if rec.get("provisional"):
+            provisional[name] = rec
+        else:
+            final[name] = rec
+    return {**provisional, **final}
+
+
+def load_baseline(
+    path: Optional[str] = None, search_dir: str = ".",
+) -> dict[str, dict]:
+    """The previous run's records for regression stamping: an explicit
+    ``path`` (``--baseline``), else the newest ``BENCH_*.json`` in
+    ``search_dir`` by round number. Empty dict when nothing is found —
+    the first round of a fresh checkout has no trend."""
+    if path is None:
+        import glob
+
+        candidates = sorted(
+            glob.glob(os.path.join(search_dir, "BENCH_*.json"))
+        )
+        if not candidates:
+            return {}
+        path = candidates[-1]
+    try:
+        with open(path) as f:
+            return parse_baseline_records(f.read())
+    except OSError:
+        return {}
+
+
 class BenchRunner:
     def __init__(
         self,
@@ -121,6 +188,7 @@ class BenchRunner:
         sleep: Callable[[float], None] = time.sleep,
         settle_s: float = 60.0,
         on_tpu: bool = True,
+        baseline: Optional[dict[str, dict]] = None,
     ):
         self.registry = registry
         self.scheduler = scheduler
@@ -136,9 +204,14 @@ class BenchRunner:
         # retry without the settle usually measures the same degradation
         self.settle_s = settle_s
         self.on_tpu = on_tpu
+        # {variant: prior record} from the previous round — every landed
+        # record passes through _publish, so stamping there covers the
+        # provisional stream and the consolidated block alike
+        self.baseline = baseline or {}
         self.results: dict[str, dict] = {}
         self.errors: dict[str, str] = {}
         self.skipped: list[dict] = []
+        self.oom_reports: dict[str, str] = {}  # variant -> autopsy path
 
     # ---------------------------------------------------------------- run
     def run(self) -> int:
@@ -177,8 +250,38 @@ class BenchRunner:
         self.skipped.append(rec)
         self.emit(json.dumps(rec))
 
+    def _stamp_trend(self, name: str, rec: dict) -> None:
+        """Run-to-run trend: attach the previous round's value and flag
+        a >10% degradation of the variant's metric. Partial records are
+        stamped with ``prev_*`` but never flagged — a budget-killed
+        measurement is not evidence of a regression."""
+        prev = self.baseline.get(name)
+        if prev is None or rec.get("value") is None:
+            return
+        rec["prev_value"] = prev.get("value")
+        if prev.get("prev_round") is not None:
+            rec["prev_round"] = prev["prev_round"]
+        prev_value = prev.get("value")
+        if not prev_value or rec.get("partial"):
+            return
+        unit = rec.get("unit") or prev.get("unit") or ""
+        change = (float(rec["value"]) - float(prev_value)) / float(prev_value)
+        rec["prev_delta_pct"] = round(100.0 * change, 2)
+        degraded = (
+            change > 0.10 if unit in _LOWER_IS_BETTER_UNITS
+            else change < -0.10
+        )
+        if degraded:
+            rec["regression"] = True
+            self.log(
+                f"REGRESSION: {name} {rec.get('metric')} "
+                f"{prev_value} -> {rec['value']} {unit} "
+                f"({rec['prev_delta_pct']:+.1f}%)"
+            )
+
     def _publish(self, name: str, rec: dict) -> None:
         rec.setdefault("variant", name)
+        self._stamp_trend(name, rec)
         self.results[name] = rec
         # Emit the record the moment the variant lands, flushed, so a
         # driver wall-clock kill cannot discard completed measurements
@@ -239,6 +342,31 @@ class BenchRunner:
         )
         return True
 
+    def _harvest_oom_autopsy(self, crashed: list[Variant]) -> None:
+        """An OOM child wrote its ``oom-report.json`` autopsy next to the
+        partial snapshots before dying; surface it in the stream so the
+        expected-OOM variants (``longseq_xla``) leave a machine-readable
+        artifact instead of just a stderr line."""
+        if not self.partial_dir:
+            return
+        try:
+            from ..profiling.oom import OOM_REPORT_NAME, read_oom_report
+        except Exception:  # noqa: BLE001 — forensics stay best-effort
+            return
+        report = read_oom_report(self.partial_dir)
+        if report is None:
+            return
+        path = os.path.join(self.partial_dir, OOM_REPORT_NAME)
+        for v in crashed:
+            self.oom_reports[v.name] = path
+            self.emit(json.dumps({
+                "variant": v.name,
+                "oom_report": path,
+                "oom_context": report.get("context"),
+                "oom_requested_bytes": report.get("requested_bytes"),
+            }))
+        self.log(f"OOM autopsy recovered: {path}")
+
     # --------------------------------------------------------- group loop
     def _run_group(self, group_members: list[Variant],
                    budget_s: float) -> None:
@@ -290,6 +418,8 @@ class BenchRunner:
                 err = (res.stderr or "no output").strip()
                 oom = _oom_line(err)
                 if oom or attempt == 1:
+                    if oom:
+                        self._harvest_oom_autopsy(crashed)
                     for v in crashed:
                         self._fail(v.name, oom or err[-300:] or "no output")
                     crashed = []
@@ -378,6 +508,10 @@ class BenchRunner:
                 extra["flash_speedup_vs_xla"] = None
                 if "longseq_xla" in errors:
                     extra["xla_error"] = errors.pop("longseq_xla")[:160]
+                if "longseq_xla" in self.oom_reports:
+                    # the expected-OOM comparison point: its autopsy IS
+                    # the artifact (requested bytes + ledger + census)
+                    extra["xla_oom_report"] = self.oom_reports["longseq_xla"]
             # the S=4096 pair, where dense attention fits 16G: always
             # record whichever step times landed (even a lone one — never
             # discard a valid measurement), and let the pair supply the

@@ -38,6 +38,7 @@ from .overlap import (
     merge_compiler_options,
     overlap_from_spans,
     overlap_options,
+    top_self_time_ops,
 )
 from .warmup import batch_spec_of, spec_like, warm_step
 
@@ -53,6 +54,7 @@ __all__ = [
     "merge_compiler_options",
     "overlap_from_spans",
     "overlap_options",
+    "top_self_time_ops",
     "batch_spec_of",
     "spec_like",
     "warm_step",

@@ -343,6 +343,125 @@ def _is_device_plane(name: str) -> bool:
     return name.startswith("/device:") and "CPU" not in name
 
 
+# --------------------------------------------------------------------- #
+# evidence: op-level self-time breakdown
+# --------------------------------------------------------------------- #
+def self_times_from_plane(plane: dict) -> dict[str, tuple[int, int]]:
+    """Per-op-name **self time** (nested children subtracted) from one
+    plane -> ``{name: (self_ps, count)}``.
+
+    Trace lines nest: a fusion event contains the sub-op events it
+    fused, so summing raw durations double-counts every level. Within
+    each line, events are walked in ``(start, -end)`` order with a stack
+    of open intervals; an event fully inside the stack top is its child,
+    and a parent's self time is its duration minus the directly-enclosed
+    child durations.
+    """
+    names = plane["event_names"]
+    totals: dict[str, list[int]] = {}
+    for line in plane["lines"]:
+        events = []
+        for metadata_id, offset_ps, duration_ps in line["events"]:
+            if duration_ps <= 0:
+                continue
+            events.append(
+                (offset_ps, offset_ps + duration_ps,
+                 names.get(metadata_id, ""))
+            )
+        events.sort(key=lambda e: (e[0], -e[1]))
+        # stack entries: [end_ps, duration_ps, child_ps, name]
+        stack: list[list] = []
+        def _pop():
+            end, dur, child, name = stack.pop()
+            slot = totals.setdefault(name, [0, 0])
+            slot[0] += max(dur - child, 0)
+            slot[1] += 1
+            if stack:
+                stack[-1][2] += dur
+        for start, end, name in events:
+            while stack and stack[-1][0] <= start:
+                _pop()
+            stack.append([end, end - start, 0, name])
+        while stack:
+            _pop()
+    return {name: (ps, n) for name, (ps, n) in totals.items()}
+
+
+def top_ops_from_plane(plane: dict, k: int = 5) -> list[dict]:
+    """Top-``k`` ops by self time in one plane, as JSON-ready dicts
+    ``{"op", "self_time_ms", "count"}`` sorted descending."""
+    ranked = sorted(
+        self_times_from_plane(plane).items(),
+        key=lambda kv: -kv[1][0],
+    )[: max(k, 0)]
+    return [
+        {
+            "op": name,
+            "self_time_ms": round(ps / 1e9, 6),
+            "count": count,
+        }
+        for name, (ps, count) in ranked
+        if ps > 0
+    ]
+
+
+def top_self_time_ops(trace_dir: str, k: int = 5) -> Optional[list[dict]]:
+    """Best-effort top-``k`` op breakdown for one capture directory.
+
+    Walks ``trace_dir`` for ``*.xplane.pb`` dumps, aggregates self time
+    per op name across every accelerator device plane (falling back to
+    host/CPU planes when no device plane exists — the CPU test backend
+    still produces a meaningful breakdown), and returns the ranked list
+    or None when nothing parses. Never raises.
+    """
+    try:
+        paths = []
+        for root, _, files in os.walk(trace_dir):
+            paths.extend(
+                os.path.join(root, f)
+                for f in files
+                if f.endswith(".xplane.pb")
+            )
+        device_totals: dict[str, list[int]] = {}
+        host_totals: dict[str, list[int]] = {}
+        for path in sorted(paths):
+            try:
+                with open(path, "rb") as fh:
+                    planes = parse_xspace_planes(fh.read())
+            except (OSError, ValueError, IndexError) as exc:
+                logger.debug(f"skipping unparseable xplane {path}: {exc}")
+                continue
+            for plane in planes:
+                totals = (
+                    device_totals
+                    if _is_device_plane(plane["name"])
+                    else host_totals
+                )
+                for name, (ps, count) in self_times_from_plane(
+                    plane
+                ).items():
+                    slot = totals.setdefault(name, [0, 0])
+                    slot[0] += ps
+                    slot[1] += count
+        totals = device_totals or host_totals
+        if not totals:
+            return None
+        ranked = sorted(totals.items(), key=lambda kv: -kv[1][0])
+        out = [
+            {
+                "op": name,
+                "self_time_ms": round(ps / 1e9, 6),
+                "count": count,
+            }
+            for name, (ps, count) in ranked[: max(k, 0)]
+            if ps > 0
+        ]
+        return out or None
+    except Exception as exc:  # diagnostics never take down training
+        logger.debug(f"top_self_time_ops({trace_dir}) failed: {exc}")
+        return None
+
+
 def collective_compute_overlap(trace_dir: str) -> Optional[dict[str, Any]]:
     """Best-effort overlap report for one profile capture directory.
 

@@ -12,7 +12,13 @@ event classes:
   rolling median;
 * ``nan_grad`` — non-finite loss or grad norm, or ``grads_finite == 0``
   (the fp16 overflow-skip signal), flagged immediately with no baseline
-  needed.
+  needed;
+* ``memory_leak`` — over the ``kind="memory"`` census stream (not step
+  records): ``census_unowned_bytes`` growing monotonically across
+  ``leak_min_samples`` consecutive censuses by at least
+  ``leak_min_growth_bytes`` total. Memory *nobody claims* that only
+  ever grows is the leak signature; owned growth (a filling KV pool) is
+  expected and never alarms.
 
 Each fired anomaly becomes one ``kind="anomaly"`` record carrying the
 offending step's FULL record (the evidence travels with the alarm), and
@@ -61,6 +67,10 @@ class AnomalyDetector:
         self._suppressed: dict[str, int] = collections.defaultdict(int)
         self.counts: dict[str, int] = collections.defaultdict(int)
         self._observed = 0  # step records seen, for baseline sampling
+        # unowned-census trail for the leak rule: (sample, bytes) pairs
+        self._unowned: collections.deque = collections.deque(
+            maxlen=max(self.config.leak_min_samples, 2)
+        )
 
     # ------------------------------------------------------------------ #
     def _fire(
@@ -206,6 +216,41 @@ class AnomalyDetector:
         if sampled and gnorm is not None and self._finite(gnorm):
             self._windows["grad_norm"].append(float(gnorm))
         return out
+
+    def observe_memory(
+        self,
+        record: dict,
+        now: Optional[float] = None,
+    ) -> list[dict]:
+        """Check one ``kind="memory"`` census record for the leak
+        signature: *unowned* bytes rising on EVERY one of the last
+        ``leak_min_samples`` censuses, with total growth of at least
+        ``leak_min_growth_bytes``. Strict monotonicity is the filter
+        that keeps a noisy-but-stable pool quiet — one flat or falling
+        census resets the trail."""
+        if record.get("kind") != "memory":
+            return []
+        unowned = record.get("census_unowned_bytes")
+        if unowned is None:
+            return []
+        now = time.monotonic() if now is None else now
+        cfg = self.config
+        trail = self._unowned
+        if trail and unowned <= trail[-1]:
+            trail.clear()
+        trail.append(int(unowned))
+        if len(trail) < cfg.leak_min_samples:
+            return []
+        growth = trail[-1] - trail[0]
+        if growth < cfg.leak_min_growth_bytes:
+            return []
+        rec = self._fire(
+            "memory_leak", record, now,
+            value=float(unowned),
+            growth_bytes=int(growth),
+            samples=len(trail),
+        )
+        return [rec] if rec else []
 
     def observe_slo(
         self,

@@ -48,6 +48,12 @@ class DiagnosticsConfig:
     sampling makes the per-step cost O(1) amortized for sub-millisecond
     steps where even that shows up. 1 (default) checks every step.
 
+    **Leak detection** — over ``kind="memory"`` census records:
+    ``memory_leak`` fires when *unowned* census bytes rise on every one
+    of the last ``leak_min_samples`` censuses by at least
+    ``leak_min_growth_bytes`` total (owned growth — a KV pool filling —
+    never alarms). Same cooldown machinery as the other types.
+
     **Triggered trace capture** — when an anomaly fires (or
     ``trigger_file`` appears / SIGUSR1 arrives), the next
     ``capture_steps`` steps are captured with ``jax.profiler`` into
@@ -76,6 +82,9 @@ class DiagnosticsConfig:
     anomaly_cooldown_steps: int = 50
     anomaly_cooldown_s: float = 30.0
     anomaly_sample_every: int = 1
+    # leak detection (over kind="memory" census records)
+    leak_min_samples: int = 5
+    leak_min_growth_bytes: int = 1 << 20
     # triggered trace capture
     trace_dir: Optional[str] = None
     capture_steps: int = 3
@@ -106,6 +115,10 @@ class DiagnosticsConfig:
             raise ValueError("slow_step_factor must be > 1")
         if self.anomaly_sample_every < 1:
             raise ValueError("anomaly_sample_every must be >= 1")
+        if self.leak_min_samples < 2:
+            raise ValueError("leak_min_samples must be >= 2")
+        if self.leak_min_growth_bytes < 0:
+            raise ValueError("leak_min_growth_bytes must be >= 0")
         if self.capture_steps < 1:
             raise ValueError("capture_steps must be >= 1")
         if self.max_captures < 0:

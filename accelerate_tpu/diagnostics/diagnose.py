@@ -230,6 +230,44 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
                 entry[f"slo_{key}"] = slo.get(key)
         serving[rank] = entry
 
+    # --- where did the memory go ---------------------------------------- #
+    # latest kind="memory" census per rank from each dump's flight ring
+    # (resident-byte posture at death, by owner), the newest step record
+    # carrying a top-ops breakdown, and the OOM autopsy when one landed
+    # in the dump dir. Only present when memory records/autopsies exist.
+    memory: dict[int, dict[str, Any]] = {}
+    top_ops: Optional[dict[str, Any]] = None
+    for rank, dump in dumps.items():
+        mem = None
+        for rec in dump.get("records", []):
+            kind = rec.get("kind")
+            if kind == "memory":
+                mem = rec  # records are in order: keep the latest
+            elif kind == "step" and rec.get("top_ops"):
+                top_ops = {
+                    "rank": rank,
+                    "step": rec.get("step"),
+                    "ops": rec["top_ops"],
+                }
+        if mem is None:
+            continue
+        memory[rank] = {
+            key: mem.get(key)
+            for key in (
+                "step", "census_total_bytes", "census_unowned_bytes",
+                "census_owner_bytes", "census_arrays",
+                "hbm_bytes_in_use", "peak_hbm_bytes", "hbm_bytes_limit",
+                "host_rss_bytes", "host_rss_peak_bytes",
+            )
+        }
+    oom_report = None
+    try:
+        from ..profiling.oom import read_oom_report
+
+        oom_report = read_oom_report(dir)
+    except Exception:
+        oom_report = None
+
     return {
         "dir": dir,
         "num_ranks": len(ranks),
@@ -247,7 +285,21 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
         "heartbeat_stalls": stalls,
         "exceptions": exceptions,
         "serving": serving,
+        "memory": memory,
+        "top_ops": top_ops,
+        "oom_report": oom_report,
     }
+
+
+def _fmt_bytes(n: Any) -> str:
+    if n is None:
+        return "n/a"
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(value) < 1024.0:
+            return f"{value:.0f}{unit}" if unit == "B" else f"{value:.1f}{unit}"
+        value /= 1024.0
+    return f"{value:.1f}TiB"
 
 
 def format_report(report: dict) -> str:
@@ -401,6 +453,64 @@ def format_report(report: dict) -> str:
                         else ""
                     )
                 )
+    memory = report.get("memory") or {}
+    if memory:
+        lines.append("")
+        lines.append("Memory (latest census per rank):")
+        for rank in sorted(memory):
+            m = memory[rank]
+            owners = m.get("census_owner_bytes") or {}
+            top = sorted(owners.items(), key=lambda kv: -(kv[1] or 0))[:4]
+            owner_str = " ".join(
+                f"{name}={_fmt_bytes(n)}" for name, n in top
+            )
+            lines.append(
+                f"  rank {rank}: total={_fmt_bytes(m.get('census_total_bytes'))} "
+                f"unowned={_fmt_bytes(m.get('census_unowned_bytes'))}"
+                + (f" ({owner_str})" if owner_str else "")
+            )
+            if m.get("hbm_bytes_in_use") is not None:
+                lines.append(
+                    f"    device: in_use={_fmt_bytes(m.get('hbm_bytes_in_use'))} "
+                    f"peak={_fmt_bytes(m.get('peak_hbm_bytes'))} "
+                    f"limit={_fmt_bytes(m.get('hbm_bytes_limit'))}"
+                )
+    top_ops = report.get("top_ops")
+    if top_ops:
+        lines.append(
+            f"Top ops by self-time (rank {top_ops.get('rank')}, "
+            f"step {top_ops.get('step')}):"
+        )
+        for op in top_ops.get("ops") or []:
+            lines.append(
+                f"  {op.get('self_time_ms'):>10.3f}ms x{op.get('count'):<5} "
+                f"{op.get('op')}"
+            )
+    oom = report.get("oom_report")
+    if oom:
+        lines.append("")
+        lines.append(
+            f"OOM AUTOPSY ({oom.get('context')}): "
+            f"requested={_fmt_bytes(oom.get('requested_bytes'))}"
+        )
+        ledger = oom.get("ledger") or {}
+        if ledger:
+            lines.append(
+                f"  ledger: budget={_fmt_bytes(ledger.get('budget_bytes'))} "
+                f"capacity={_fmt_bytes(ledger.get('capacity_bytes'))} "
+                f"temp_peak={_fmt_bytes(ledger.get('program_temp_peak_bytes'))}"
+            )
+            for name, n in sorted(
+                (ledger.get("owners") or {}).items(),
+                key=lambda kv: -(kv[1] or 0),
+            ):
+                lines.append(f"    {name:<14} {_fmt_bytes(n)}")
+        for prog in oom.get("top_programs") or []:
+            lines.append(
+                f"  program {prog.get('label')}: "
+                f"temp={_fmt_bytes(prog.get('temp_bytes'))} "
+                f"args={_fmt_bytes(prog.get('argument_bytes'))}"
+            )
     if report.get("heartbeat_stalls"):
         lines.append(f"Heartbeat stalls recorded: {report['heartbeat_stalls']}")
     for exc in report.get("exceptions", []):

@@ -94,6 +94,23 @@ class DiagnosticsManager:
                     if self.config.capture_on_anomaly:
                         self.capture.request("anomaly_slo_breach")
             return out
+        if kind == "memory":
+            # the live-buffer census stream: the leak rule watches the
+            # unowned bucket for monotone growth (same alarm/capture
+            # treatment as step anomalies)
+            out = []
+            if self.anomaly is not None:
+                for anom in self.anomaly.observe_memory(record):
+                    out.append(anom)
+                    self.recorder.event(
+                        "anomaly",
+                        anomaly_type=anom["anomaly_type"],
+                        value=anom.get("value"),
+                        growth_bytes=anom.get("growth_bytes"),
+                    )
+                    if self.config.capture_on_anomaly:
+                        self.capture.request("anomaly_memory_leak")
+            return out
         if kind != "step":
             return []
 
@@ -123,8 +140,17 @@ class DiagnosticsManager:
         if finished is not None:
             # collective/compute overlap evidence from the fresh trace
             # (best-effort: None on CPU / unparseable dumps)
-            from ..compilation.overlap import collective_compute_overlap
+            from ..compilation.overlap import (
+                collective_compute_overlap,
+                top_self_time_ops,
+            )
 
+            top_ops = top_self_time_ops(finished["dir"], k=5)
+            if top_ops:
+                self._pending_step_fields["top_ops"] = top_ops
+                self._pending_step_fields["top_ops_capture_dir"] = (
+                    finished["dir"]
+                )
             report = collective_compute_overlap(finished["dir"])
             if report is not None:
                 self._pending_step_fields["overlap_pct"] = round(

@@ -1,0 +1,50 @@
+"""HBM & compute attribution plane.
+
+Three host-side pieces answering "where do the bytes and FLOPs go":
+
+* :mod:`registry` — every compiled executable registers its
+  ``memory_analysis()``/``cost_analysis()`` under a label; the registry
+  folds them into an HBM budget ledger and per-program rooflines.
+* :mod:`census` — ``jax.live_arrays()`` aggregated by logical owner
+  (params / opt / KV pools / adapters / unowned), the source of the
+  ``kind="memory"`` telemetry records and the leak detector's signal.
+* :mod:`oom` — RESOURCE_EXHAUSTED autopsies: an atomic
+  ``oom-report.json`` written from already-resident data at the
+  step/engine/bench boundaries.
+
+All default-on behavior is record-only; nothing here changes numerics
+or trace shapes (the zero-retrace contracts are asserted with the plane
+enabled in ``tests/test_profiling.py``).
+"""
+
+from .census import BufferCensus
+from .oom import (
+    ENV_OOM_DIR,
+    OOM_REPORT_NAME,
+    is_resource_exhausted,
+    oom_report_dir,
+    parse_requested_bytes,
+    read_oom_report,
+    write_oom_report,
+)
+from .registry import (
+    ProgramRecord,
+    ProgramRegistry,
+    get_program_registry,
+    reset_program_registry,
+)
+
+__all__ = [
+    "BufferCensus",
+    "ENV_OOM_DIR",
+    "OOM_REPORT_NAME",
+    "is_resource_exhausted",
+    "oom_report_dir",
+    "parse_requested_bytes",
+    "read_oom_report",
+    "write_oom_report",
+    "ProgramRecord",
+    "ProgramRegistry",
+    "get_program_registry",
+    "reset_program_registry",
+]

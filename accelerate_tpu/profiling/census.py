@@ -1,0 +1,161 @@
+"""Live-buffer census: who owns the HBM, sampled from ``jax.live_arrays``.
+
+The allocator's ``bytes_in_use`` says *how much* device memory is live;
+the census says *whose* it is. Subsystems register an **owner** — a name
+plus a zero-argument provider returning the pytree (or iterable) of
+arrays that owner currently holds — and :meth:`BufferCensus.sample`
+walks every live ``jax.Array`` once, attributing each to the first
+owner whose provider yielded it. Whatever no owner claims is
+``unowned`` — the bucket the anomaly detector watches for monotone
+growth (a leak is, by definition, memory nobody will admit to).
+
+Providers, not captured ids: donation replaces the carry's buffers every
+step, so an id captured at registration time is stale one step later.
+The step wrapper stashes the *latest* carry reference (O(1) per step)
+and the provider re-traverses it only when a sample is actually taken.
+
+Everything is host-side and best-effort: a provider that raises is
+skipped (its bytes fall into ``unowned`` — visible, not fatal), and
+``sample`` never throws on the train loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional, Union
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+Provider = Callable[[], Any]
+
+
+def _iter_arrays(tree: Any) -> Iterable[Any]:
+    """Flatten a provider result (pytree / iterable / single array) into
+    jax.Array leaves."""
+    import jax
+
+    if tree is None:
+        return []
+    return [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "nbytes")
+    ]
+
+
+class BufferCensus:
+    """Owner-attributed snapshot of all live device arrays."""
+
+    def __init__(self, min_interval_s: float = 0.0):
+        self._lock = threading.Lock()
+        self._owners: dict[str, Provider] = {}
+        self.min_interval_s = float(min_interval_s)
+        self._last_sample_t: float = 0.0
+        self._host_rss_peak = 0
+        #: the most recent sample dict (what OOM forensics serializes —
+        #: crash handlers must never take a fresh walk)
+        self.last: Optional[dict] = None
+
+    # ------------------------------------------------------------- #
+    # ownership
+    # ------------------------------------------------------------- #
+    def set_owner(
+        self, name: str, provider: Union[Provider, Any],
+    ) -> None:
+        """Register/replace one owner. ``provider`` is a zero-arg
+        callable returning the owner's current arrays; a non-callable is
+        wrapped as a constant (fine for never-donated pools)."""
+        if not callable(provider):
+            tree = provider
+            provider = lambda: tree  # noqa: E731
+        with self._lock:
+            self._owners[name] = provider
+
+    def remove_owner(self, name: str) -> None:
+        with self._lock:
+            self._owners.pop(name, None)
+
+    def owners(self) -> list[str]:
+        with self._lock:
+            return list(self._owners)
+
+    # ------------------------------------------------------------- #
+    # sampling
+    # ------------------------------------------------------------- #
+    def sample(self) -> dict:
+        """One census: flat JSON-ready fields (see keys below).
+
+        * ``census_owner_bytes``: {owner: bytes} for every registered
+          owner (0 when its arrays are gone);
+        * ``census_unowned_bytes``: live bytes no owner claimed;
+        * ``census_total_bytes`` / ``census_arrays``: the whole pool;
+        * host fields (``host_rss_bytes``, ``host_rss_peak_bytes``)
+          folding the old ``PeakHostMemory`` RSS sampling into the same
+          record (the peak is the max RSS seen across census samples).
+
+        Attribution is by object identity against ``jax.live_arrays()``
+        — an owner's bytes are the sum of its leaves that are genuinely
+        live, each array counted once even when two owners claim it.
+        """
+        import jax
+
+        from ..utils.profiling import host_memory_rss
+
+        with self._lock:
+            owners = dict(self._owners)
+        try:
+            live = list(jax.live_arrays())
+        except Exception as exc:  # noqa: BLE001 — census never fatal
+            logger.debug(f"live_arrays() failed: {exc}")
+            live = []
+        pool: dict[int, int] = {}
+        for arr in live:
+            try:
+                pool[id(arr)] = int(arr.nbytes)
+            except Exception:  # noqa: BLE001 — deleted/exotic arrays
+                continue
+        total = sum(pool.values())
+        unclaimed = dict(pool)
+        owner_bytes: dict[str, int] = {}
+        for name, provider in owners.items():
+            claimed = 0
+            try:
+                leaves = _iter_arrays(provider())
+            except Exception as exc:  # noqa: BLE001 — skip broken owner
+                logger.debug(f"census owner {name!r} provider failed: {exc}")
+                leaves = []
+            for leaf in leaves:
+                claimed += unclaimed.pop(id(leaf), 0)
+            owner_bytes[name] = claimed
+        rss = host_memory_rss()
+        self._host_rss_peak = max(self._host_rss_peak, rss)
+        self._last_sample_t = time.monotonic()
+        self.last = {
+            "census_total_bytes": total,
+            "census_unowned_bytes": sum(unclaimed.values()),
+            "census_owner_bytes": owner_bytes,
+            "census_arrays": len(pool),
+            "host_rss_bytes": rss,
+            "host_rss_peak_bytes": self._host_rss_peak,
+        }
+        return self.last
+
+    def maybe_sample(self, *, force: bool = False) -> Optional[dict]:
+        """Throttled :meth:`sample`: None when the last sample is more
+        recent than ``min_interval_s`` (cadence callers pass through
+        here so a hot loop with a small ``census_interval`` still can't
+        spend more than one walk per interval of wall clock)."""
+        if not force and self.min_interval_s > 0:
+            if (
+                time.monotonic() - self._last_sample_t
+                < self.min_interval_s
+            ):
+                return None
+        try:
+            return self.sample()
+        except Exception as exc:  # noqa: BLE001 — belt and braces
+            logger.debug(f"census sample failed: {exc}")
+            return None

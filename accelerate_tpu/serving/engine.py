@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..logging import get_logger
 from ..ops.attention import PagedKVState
 from .block_pool import BlockPool, PrefixCache
 from .sampling import SlotSampling, sample_tokens
@@ -47,6 +48,8 @@ from .slo import SLOConfig, SloTracker
 from .spans import SpanLog, write_chrome_trace
 from .speculation import DraftModelProposer, NGramProposer, SpecConfig
 from .telemetry import ServeStats, percentile
+
+logger = get_logger(__name__)
 
 
 @dataclass(frozen=True)
@@ -189,6 +192,9 @@ class ServingEngine:
         self._steps = 0
         self._http: Any = None
         self._traces = {"prefill": 0, "decode": 0, "cow": 0, "verify": 0}
+        # every bucket width a prefill ever ran at — the set
+        # capture_programs() reconstructs abstract specs from
+        self._prefill_buckets: set[int] = set()
 
         from ..models.generation import init_cache
 
@@ -350,6 +356,7 @@ class ServingEngine:
         self._spec_rounds_total = 0
         if spec_decode is not None:
             self.set_speculation(spec_decode)
+        self._register_census_owners()
 
     # ------------------------------------------------------------------ #
     # request API
@@ -426,6 +433,16 @@ class ServingEngine:
         admit + prefill queued requests into the empty seats, then run
         ONE decode step over the whole slot batch. Returns the tokens
         produced this iteration."""
+        try:
+            return self._step_inner()
+        except Exception as exc:
+            # device OOM: the autopsy is written from state already in
+            # memory (ledger + last census + pool stats), then the
+            # original error propagates untouched
+            self._handle_oom(exc, context="serving_step")
+            raise
+
+    def _step_inner(self) -> list[TokenEvent]:
         had_work = self.scheduler.has_work
         events: list[TokenEvent] = []
         for req in self.scheduler.shed_expired():
@@ -613,6 +630,7 @@ class ServingEngine:
         tail = req.prompt[cached:]
         tail_len = prompt_len - cached
         bucket = _next_pow2(tail_len)
+        self._prefill_buckets.add(bucket)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :tail_len] = tail
         table = np.zeros((1, self._max_table), np.int32)
@@ -923,9 +941,179 @@ class ServingEngine:
 
     def _sample_gauges(self) -> None:
         self._tele("record_serve_gauge", **self._gauge_fields())
+        # piggy-back the HBM census on the gauge cadence (the census's
+        # own wall-clock throttle bounds the walk rate)
+        self._tele("sample_memory")
 
     def _emit_slo(self) -> None:
         self._tele("record_slo", **self.slo_tracker.snapshot(self._now()))
+
+    def _register_census_owners(self) -> None:
+        """Point the telemetry's buffer census at this engine's resident
+        pytrees. Providers re-read the live attributes at sample time, so
+        cache churn / adapter swaps / speculation toggles stay correctly
+        attributed without re-registration."""
+        census = getattr(self._telemetry, "census", None)
+        if census is None:
+            return
+        census.set_owner("params", lambda: self.params)
+        census.set_owner("kv_cache", lambda: self.cache)
+        census.set_owner(
+            "adapter_stack",
+            lambda: (
+                (self.adapters.stacks(), self.adapters.scales())
+                if self.adapters is not None else None
+            ),
+        )
+        census.set_owner(
+            "draft_pool",
+            lambda: (
+                (
+                    getattr(self._proposer, "cache", None),
+                    getattr(self._proposer, "params", None),
+                )
+                if self._proposer is not None else None
+            ),
+        )
+
+    def _handle_oom(self, exc: BaseException, *, context: str) -> None:
+        """RESOURCE_EXHAUSTED boundary: write the atomic autopsy from
+        already-resident state (never a fresh census walk), dump the
+        flight ring, return so the caller re-raises. Never raises."""
+        try:
+            from ..profiling.oom import is_resource_exhausted, write_oom_report
+
+            if not is_resource_exhausted(exc):
+                return
+            census = getattr(self._telemetry, "census", None)
+            diag = getattr(self._telemetry, "diagnostics", None)
+            directory = diag.config.dir if diag is not None else None
+            path = write_oom_report(
+                exc,
+                context=context,
+                census=getattr(census, "last", None),
+                pool_stats=self.pool.stats(),
+                directory=directory,
+                extra={"engine_steps": self._steps,
+                       "slots_active": sum(
+                           1 for s in self.scheduler.slots if s.busy
+                       )},
+            )
+            if diag is not None:
+                diag.recorder.event(
+                    "oom", context=context, report_path=path,
+                    error=str(exc)[:500],
+                )
+        except Exception:  # noqa: BLE001 — forensics never mask the OOM
+            pass
+
+    def capture_programs(self, registry: Any = None) -> list[str]:
+        """Register every compiled serving program with the process-wide
+        :class:`~accelerate_tpu.profiling.ProgramRegistry`.
+
+        jit's call cache and the AOT ``lower().compile()`` cache are
+        separate, so holding a ``Compiled`` in hand costs ONE explicit
+        AOT compile per program — this is an explicit, once-per-topology
+        call (after warmup), not something the hot path pays. Abstract
+        specs are reconstructed analytically from the engine's shape
+        contract (the fixed decode/verify batch shapes, every prefill
+        bucket seen so far); the ``.lower()`` re-traces each closure, so
+        the trace counters are snapshotted and restored — the
+        zero-retrace contract's counters stay at their steady-state
+        values. Returns the labels registered."""
+        import time as _time
+
+        from ..profiling.registry import get_program_registry
+
+        # NOT `registry or ...`: an empty ProgramRegistry is falsy (len 0)
+        registry = get_program_registry() if registry is None else registry
+
+        def _abs(tree):
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    jnp.shape(x), jnp.result_type(x)
+                ),
+                tree,
+            )
+
+        params_s = _abs(self.params)
+        cache_s = _abs(self.cache)
+        key_s = _abs(self._key)
+        temps_s = _abs(self.sampling.temperatures())
+        i32 = jnp.int32
+        labels: list[str] = []
+        snapshot = dict(self._traces)
+
+        def _one(label, fn, *specs, **meta):
+            t0 = _time.perf_counter()
+            try:
+                compiled = fn.lower(*specs).compile()
+            except Exception as exc:  # noqa: BLE001 — partial > none
+                logger.debug(f"capture_programs({label}) failed: {exc}")
+                return
+            registry.register_compiled(
+                label, compiled, kind="serve",
+                compile_seconds=_time.perf_counter() - t0, **meta,
+            )
+            labels.append(label)
+
+        try:
+            lora1 = tuple(_abs(a) for a in self._lora_call_args([0]))
+            lora_n = tuple(
+                _abs(a) for a in self._lora_call_args(self._slot_adapter)
+            )
+            for bucket in sorted(self._prefill_buckets):
+                _one(
+                    f"serve_prefill_b{bucket}", self._prefill_fn,
+                    params_s, cache_s,
+                    jax.ShapeDtypeStruct((1, bucket), i32),
+                    jax.ShapeDtypeStruct((1, self._max_table), i32),
+                    jax.ShapeDtypeStruct((1,), i32),
+                    jax.ShapeDtypeStruct((1,), i32),
+                    key_s,
+                    jax.ShapeDtypeStruct((1,), jnp.float32),
+                    *lora1,
+                    bucket=bucket,
+                )
+            _one(
+                "serve_decode", self._decode_fn,
+                params_s, cache_s,
+                jax.ShapeDtypeStruct((self.max_slots, 1), i32),
+                jax.ShapeDtypeStruct((self.max_slots, self._max_table), i32),
+                jax.ShapeDtypeStruct((self.max_slots,), i32),
+                jax.ShapeDtypeStruct((self.max_slots,), i32),
+                temps_s, key_s, *lora_n,
+            )
+            for width, vfn in sorted(self._verify_fns.items()):
+                keys_s = jax.ShapeDtypeStruct(
+                    (width,) + tuple(jnp.shape(self._key)),
+                    jnp.result_type(self._key),
+                )
+                _one(
+                    f"serve_verify_w{width}", vfn,
+                    params_s, cache_s,
+                    jax.ShapeDtypeStruct((self.max_slots, width), i32),
+                    jax.ShapeDtypeStruct(
+                        (self.max_slots, self._max_table), i32
+                    ),
+                    jax.ShapeDtypeStruct((self.max_slots,), i32),
+                    jax.ShapeDtypeStruct((self.max_slots,), i32),
+                    temps_s, keys_s, *lora_n,
+                    width=width,
+                )
+            _one(
+                "serve_cow", self._cow_fn,
+                cache_s,
+                jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((), i32),
+            )
+            _one("serve_key_chain", self._key_chain_fn, key_s)
+        finally:
+            # .lower() above re-traced the closures; restore the
+            # steady-state counters the zero-retrace assertions read
+            self._traces.clear()
+            self._traces.update(snapshot)
+        return labels
 
     # ------------------------------------------------------------------ #
     # observability surface
@@ -956,6 +1144,7 @@ class ServingEngine:
         else:
             self.slo_tracker = SloTracker(slo)
         self.span_log.enabled = spans
+        self._register_census_owners()
 
     def set_prefix_cache(
         self, enabled: bool, model_fingerprint: Optional[str] = None

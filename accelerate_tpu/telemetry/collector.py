@@ -82,6 +82,13 @@ class StepTelemetry:
         self.records: collections.deque = collections.deque(maxlen=config.history)
         self.heartbeat: Optional[HeartbeatMonitor] = None
         self.diagnostics = None
+        self.census = None
+        if config.enabled:
+            from ..profiling.census import BufferCensus
+
+            self.census = BufferCensus(
+                min_interval_s=config.census_min_interval_s
+            )
         self._detectors: dict[str, RecompileDetector] = {}
         self._timer = AsyncStepTimer()
         self._dl_wait = 0.0
@@ -337,6 +344,12 @@ class StepTelemetry:
 
         self._emitted += 1
         self._emit(record, raw_scalars)
+        cadence = self.config.census_interval
+        if cadence and self._emitted % cadence == 0:
+            # the live-buffer census rides the step cadence but is its
+            # own record kind: step records stay O(1), the census walk
+            # is opt-in and wall-clock throttled
+            self.sample_memory(step=step)
         if self.heartbeat is not None:
             self.heartbeat.beat(step)
         return record
@@ -498,6 +511,42 @@ class StepTelemetry:
         return self._record_event(
             "shed", label, {"request_id": request_id, "reason": reason, **fields}
         )
+
+    def record_memory(self, *, label: str = "memory", **fields) -> Optional[dict]:
+        """Emit a ``kind="memory"`` record — one owner-attributed
+        device+host memory sample (census owner breakdown, unowned
+        bytes, allocator stats, host RSS + window peak). The Prometheus
+        sink exports ``accelerate_tpu_hbm_bytes{owner}`` gauges from it;
+        diagnostics runs the unowned-growth leak rule over it."""
+        return self._record_event("memory", label, fields)
+
+    def sample_memory(
+        self,
+        *,
+        step: Optional[int] = None,
+        force: bool = False,
+        label: str = "memory",
+    ) -> Optional[dict]:
+        """Take one live-buffer census and emit it as a ``kind="memory"``
+        record unifying host and device in one schema: the census owner
+        breakdown + ``host_rss_bytes``/``host_rss_peak_bytes`` (the old
+        ``PeakHostMemory`` sampling folded in) + the allocator's
+        ``hbm_bytes_in_use``/``peak_hbm_bytes``/``hbm_bytes_limit``
+        (same field names step records already use). None while
+        disabled or when the census throttle declines (``force=True``
+        bypasses the throttle)."""
+        if not self.enabled or self.census is None:
+            return None
+        fields = self.census.maybe_sample(force=force)
+        if fields is None:
+            return None
+        stats = device_memory_stats()
+        fields["hbm_bytes_in_use"] = stats["bytes_in_use"]
+        fields["peak_hbm_bytes"] = stats["peak_bytes_in_use"]
+        fields["hbm_bytes_limit"] = stats["bytes_limit"]
+        if step is not None:
+            fields["step"] = step
+        return self.record_memory(label=label, **fields)
 
     def record_slo(self, *, label: str = "serve", **fields) -> Optional[dict]:
         """Emit a ``kind="slo"`` record — attainment + multi-window burn

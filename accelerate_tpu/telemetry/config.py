@@ -32,6 +32,15 @@ class TelemetryConfig:
     memory sampling. The probes are host-local reads, not device syncs,
     but on very fast steps a coarser cadence keeps the hot loop clean.
 
+    ``census_interval``: take an owner-attributed live-buffer census
+    (:class:`~accelerate_tpu.profiling.BufferCensus` over
+    ``jax.live_arrays()``) and emit a ``kind="memory"`` record every N
+    emitted step records; ``0`` disables (default — the census walks
+    every live array, so it is opt-in unlike the O(1) memory probes
+    above). ``census_min_interval_s`` additionally floors the wall-clock
+    spacing between walks so a sub-millisecond step loop can't spend
+    more than one walk per interval.
+
     ``tokens_fn``: ``batch -> int`` token counter for throughput. When
     None, the first array leaf with ``ndim >= 2`` supplies
     ``shape[0] * shape[1]`` (batch x seq), falling back to the leading
@@ -71,6 +80,8 @@ class TelemetryConfig:
     enabled: bool = True
     jsonl_path: Optional[str] = None
     memory_interval: int = 1
+    census_interval: int = 0
+    census_min_interval_s: float = 1.0
     tokens_fn: Optional[Callable[[Any], Optional[int]]] = None
     flops_per_token: Optional[float] = None
     device_peak_flops: Optional[float] = None
@@ -86,6 +97,10 @@ class TelemetryConfig:
     def __post_init__(self):
         if self.memory_interval < 0:
             raise ValueError("memory_interval must be >= 0")
+        if self.census_interval < 0:
+            raise ValueError("census_interval must be >= 0")
+        if self.census_min_interval_s < 0:
+            raise ValueError("census_min_interval_s must be >= 0")
         if self.history < 1:
             raise ValueError("history must be >= 1")
         if self.diagnostics is not None:

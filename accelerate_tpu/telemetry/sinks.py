@@ -151,6 +151,30 @@ shed; emitted by the ServingEngine's span log)::
     spec_tokens_accepted                 int    cumulative drafts accepted
     spec_accept_rate                     float  lifetime accepted / proposed
 
+``kind="memory"`` (one per live-buffer census, every
+``census_interval`` emitted step records — or on demand via
+``StepTelemetry.sample_memory``; ONE schema unifies device and host,
+with the step-record field names kept as-is so existing readers keep
+working)::
+
+    census_total_bytes    int   sum of every live jax.Array's nbytes
+    census_unowned_bytes  int   live bytes no registered owner claimed —
+                                the leak detector's signal
+    census_owner_bytes    dict  {owner: bytes} per registered owner
+                                (params / opt_state / kv_pool /
+                                adapters / draft KV / ...); the
+                                Prometheus sink exports each as
+                                {prefix}_hbm_bytes{owner="..."} plus an
+                                owner="unowned" series
+    census_arrays         int   number of live arrays walked
+    hbm_bytes_in_use      int   allocator view (same names as step
+    peak_hbm_bytes        int   records — the device half of the
+    hbm_bytes_limit       int   unified schema)
+    host_rss_bytes        int   current process RSS (host half; the old
+    host_rss_peak_bytes   int   PeakHostMemory sampling folded in — the
+                                peak is the max RSS across censuses)
+    step                  int?  step at sampling time when known
+
 ``kind="shed"`` (one per request refused/evicted under overload; the
 Prometheus sink counts these as
 ``{prefix}_serve_shed_total{reason="..."}``)::
@@ -200,6 +224,8 @@ on; the wall-clock attribution fold)::
 ``anomaly_cooldown_steps`` / ``anomaly_cooldown_s``)::
 
     anomaly_type           str    "slow_step" | "loss_spike" | "nan_grad"
+                                  | "memory_leak" (monotone unowned-
+                                  census growth)
     step                   int?   offending step
     value                  float  offending value (step seconds / loss /
                                   the non-finite scalar)
@@ -340,6 +366,9 @@ class PrometheusTextSink(TelemetrySink):
             if parent:
                 os.makedirs(parent, exist_ok=True)
         self._gauges: dict[tuple[str, str], float] = {}  # (metric, label) -> value
+        # (metric, label_name, label_value) -> latest value; gauges with
+        # a semantic label dimension (hbm_bytes{owner=...})
+        self._labeled_gauges: dict[tuple[str, str, str], float] = {}
         # (metric, label_name, label_value) -> monotonic count
         self._counters: dict[tuple[str, str, str], float] = {}
         # (metric, label) -> rolling observation window for quantiles;
@@ -356,6 +385,9 @@ class PrometheusTextSink(TelemetrySink):
             return
         if kind == "serve_gauge":
             self._emit_prefixed_gauges(record, "serve")
+            return
+        if kind == "memory":
+            self._emit_memory(record)
             return
         if kind == "slo":
             self._emit_slo(record)
@@ -391,6 +423,21 @@ class PrometheusTextSink(TelemetrySink):
                 (f"{self.prefix}_{section}_{key}", label)
             ] = float(value)
         self._write()
+
+    def _emit_memory(self, record: dict) -> None:
+        # per-owner HBM attribution: one gauge family with an "owner"
+        # label dimension ({prefix}_hbm_bytes{owner="kv_pool"}), plus
+        # the scalar fields as {prefix}_memory_* gauges
+        owners = dict(record.get("census_owner_bytes") or {})
+        if record.get("census_unowned_bytes") is not None:
+            owners["unowned"] = record["census_unowned_bytes"]
+        for owner, value in owners.items():
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            self._labeled_gauges[
+                (f"{self.prefix}_hbm_bytes", "owner", str(owner))
+            ] = float(value)
+        self._emit_prefixed_gauges(record, "memory")
 
     def _emit_slo(self, record: dict) -> None:
         label = str(record.get("label", "serve"))
@@ -458,6 +505,14 @@ class PrometheusTextSink(TelemetrySink):
                 if m == metric:
                     escaped = self._escape_label(label)
                     lines.append(f'{metric}{{label="{escaped}"}} {value}')
+        for metric in sorted({m for m, _, _ in self._labeled_gauges}):
+            lines.append(f"# TYPE {metric} gauge")
+            for (m, lname, lvalue), value in sorted(
+                self._labeled_gauges.items()
+            ):
+                if m == metric:
+                    escaped = self._escape_label(lvalue)
+                    lines.append(f'{metric}{{{lname}="{escaped}"}} {value}')
         for metric in sorted({m for m, _, _ in self._counters}):
             lines.append(f"# TYPE {metric} counter")
             for (m, lname, lvalue), value in sorted(self._counters.items()):
@@ -495,7 +550,12 @@ class PrometheusTextSink(TelemetrySink):
         os.replace(tmp, self.path)  # scrapers never see a torn file
 
     def close(self) -> None:
-        if self._gauges or self._counters or self._summaries:
+        if (
+            self._gauges
+            or self._labeled_gauges
+            or self._counters
+            or self._summaries
+        ):
             self._write()
 
 

@@ -59,8 +59,10 @@ def reset_singletons():
     """Reference AccelerateTestCase (test_utils/testing.py:429) resets
     singleton state between tests; we do it for every test."""
     yield
+    from accelerate_tpu.profiling import reset_program_registry
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
     AcceleratorState._reset_state()
     GradientState._reset_state()
     PartialState._reset_state()
+    reset_program_registry()
