@@ -34,11 +34,12 @@ FAST_FILES = \
   tests/test_elastic.py tests/test_fused_kernels.py \
   tests/test_slice_mesh.py tests/test_adapters.py \
   tests/test_prefix_cache.py tests/test_speculation.py \
-  tests/test_profiling.py
+  tests/test_profiling.py tests/test_loadgen.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
   diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke \
-  slice-smoke kernels-smoke lora-smoke prefix-smoke spec-smoke mem-smoke
+  slice-smoke kernels-smoke lora-smoke prefix-smoke spec-smoke mem-smoke \
+  soak-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -190,6 +191,16 @@ mem-smoke:
 	  tests/test_profiling.py::test_warmup_registers_program_and_ledger_sums \
 	  tests/test_profiling.py::test_census_owner_attribution_on_warmed_step \
 	  tests/test_profiling.py::test_oom_autopsy_survives_crashing_subprocess
+
+# soak & chaos acceptance on CPU (~30s): the whole loadgen unit tier
+# (deterministic trace, coordinated-omission guard, chaos handlers, SLO
+# window fold, report/diagnose plumbing) plus the slow-marked e2e smoke —
+# a seeded ramp->soak->fault->recovery program against a REAL engine on
+# the virtual clock, asserting a populated soak-report.json, measured
+# recovery, bounded fault damage, zero decode retraces, a reproducible
+# trace, and bounded memory in every ring (the e2e runs here, not tier 1)
+soak-smoke:
+	JAX_PLATFORMS=cpu $(PYTEST) -q tests/test_loadgen.py
 
 # diagnostics end-to-end on CPU: a tiny train loop with an injected slow
 # step and an injected NaN gradient runs with the flight recorder on,

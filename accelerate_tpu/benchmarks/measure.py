@@ -1073,6 +1073,238 @@ def _run_serve(cfg, max_slots: int, block_size: int, n_requests: int,
     }
 
 
+def _run_serve_soak(cfg, max_slots: int, block_size: int,
+                    target_requests: int, seed: int,
+                    partial: Optional[PartialWriter] = None):
+    """Soak & chaos line: the loadgen harness drives ONE ServingEngine
+    through warmup -> ramp -> soak -> fault -> recovery with an
+    OPEN-LOOP arrival process on the wall clock (arrivals land on
+    schedule no matter how far behind the engine is — coordinated
+    omission shows up as arrival lag and queueing TTFT, not as silently
+    stretched gaps). A short closed-loop probe first measures this
+    host's capacity and TTFT so the ramp rates (0.5x..2x capacity) and
+    the SLO objective scale to the hardware instead of hardcoding
+    wall-clock numbers; the top ramp intentionally overruns capacity so
+    the breach point is a real measurement. Mid-soak a
+    ``stall_decode`` chaos fault wedges the decode loop; the record
+    reports the bounded damage (sheds + SLO violations inside the
+    window) and the measured time-to-recover.
+
+    Headline: goodput tokens/s during the soak phase counting only
+    requests whose TTFT met the objective. ``vs_baseline`` is
+    objective / soak-p95-TTFT (>= 1 means the soak rate held the SLO).
+    """
+    import os
+
+    from accelerate_tpu.loadgen import (
+        Phase,
+        SoakConfig,
+        SoakHarness,
+        WorkloadConfig,
+        build_trace,
+    )
+    from accelerate_tpu.models import CausalLM, count_params
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.serving import ServingEngine, SLOConfig
+    from accelerate_tpu.serving.telemetry import ServeStats
+
+    partial = partial or _noop_writer("serve_soak")
+    _reset_state()
+    model = CausalLM(cfg)
+    abstract = unbox_params(
+        jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )
+        )
+    )["params"]
+    leaves, treedef = jax.tree_util.tree_flatten(abstract)
+    keys = jax.random.split(jax.random.PRNGKey(0), len(leaves))
+
+    @jax.jit
+    def init_bf16():
+        return jax.tree_util.tree_unflatten(treedef, [
+            jax.random.normal(k, l.shape, jnp.bfloat16)
+            * (0.02 if l.ndim > 1 else 1.0)
+            for k, l in zip(keys, leaves)
+        ])
+
+    params = init_bf16()
+    n_params = count_params(params)
+
+    max_prompt = max(8, min(cfg.max_seq_len // 4, 48))
+    workload = WorkloadConfig(
+        vocab_size=cfg.vocab_size,
+        prompt_tokens_min=4,
+        prompt_tokens_median=max(6, max_prompt // 4),
+        prompt_tokens_max=max_prompt,
+        output_tokens_min=2,
+        output_tokens_median=6,
+        output_tokens_max=24,
+        max_total_tokens=cfg.max_seq_len,
+    )
+
+    # closed-loop capacity probe (doubles as compile warmup): drain a
+    # deterministic burst twice — first pass pays the prefill buckets +
+    # decode compile, second pass is the timed measurement (its stats
+    # start from zero so the compile-laden first drain cannot inflate
+    # the derived TTFT objective)
+    engine = ServingEngine(
+        model, params, max_slots=max_slots, block_size=block_size
+    )
+    calib = build_trace(
+        workload,
+        (Phase("calib", "warmup", duration_s=1.0, rate_rps=16.0,
+               process="uniform"),),
+        seed + 1,
+    )
+
+    def drain(reqs):
+        for req in reqs:
+            engine.add_request(
+                list(req.prompt), max_new_tokens=req.max_new_tokens
+            )
+        while engine.has_work:
+            engine.step()
+
+    drain(calib)
+    engine.stats = ServeStats()
+    t0 = time.perf_counter()
+    drain(calib)
+    calib_s = max(time.perf_counter() - t0, 1e-6)
+    capacity_rps = len(calib) / calib_s
+    # the probe's p95 TTFT includes the burst's own queueing (16 deep on
+    # max_slots seats) — x2 of it is an objective the engine holds near
+    # capacity but loses when the open-loop backlog outgrows the burst
+    ttft_obj = max(0.02, (engine.summary().get("ttft_s_p95") or 0.1) * 2.0)
+    engine.stats = ServeStats()  # the soak accounts from zero
+    # production posture for the overload phases: bound the queue by the
+    # deadline clients would abandon at, so the 2x-capacity ramp SHEDS
+    # (observable damage) instead of dragging an unbounded backlog into
+    # the soak phase's steady-state measurement
+    engine.scheduler.max_queue_delay_s = 2.5 * ttft_obj
+    partial.update(
+        phase="calibrated", iters_measured=len(calib),
+        extra={"capacity_rps_closed_loop": round(capacity_rps, 2)},
+    )
+
+    # phase program scaled so total offered load ~= target_requests at
+    # the measured capacity. The ramp tops out at 2x capacity (the
+    # breach point must be real), the cooldown drains the ramp's
+    # residual queue so the soak measures STEADY state at 0.6x
+    # capacity, and the recovery window is long enough for the burn
+    # windows to clear after the stall's backlog drains.
+    c, u = capacity_rps, min(
+        3.0, max(0.6, target_requests / (9.1 * capacity_rps))
+    )
+    program = (
+        Phase("warmup", "warmup", u, max(1.0, 0.25 * c)),
+        Phase("ramp-1", "ramp", u, 0.5 * c),
+        Phase("ramp-2", "ramp", u, 1.0 * c),
+        Phase("ramp-3", "ramp", u, 1.5 * c),
+        Phase("ramp-4", "ramp", u, 2.0 * c),
+        Phase("cooldown", "warmup", u, max(1.0, 0.25 * c)),
+        Phase("soak", "soak", 2 * u, 0.6 * c),
+        Phase("fault", "fault", u, 0.6 * c),
+        Phase("recovery", "recovery", 3 * u, 0.6 * c),
+    )
+    unit_s = u
+    stall_secs = round(min(1.0, unit_s / 2), 2)
+    slo = SLOConfig(
+        ttft_objective_s=ttft_obj,
+        e2e_objective_s=ttft_obj * 10,
+        target=0.9,
+        fast_window_s=max(0.2, unit_s / 2),
+        slow_window_s=max(0.4, unit_s),
+        burn_threshold=1.0,
+        interval_steps=8,
+        min_requests=3,
+    )
+    report_path = (
+        os.path.join(os.path.dirname(partial.path), "soak-report.json")
+        if partial.path else None
+    )
+    soak_cfg = SoakConfig(
+        workload=workload,
+        phases=program,
+        seed=seed,
+        step_dt_s=None,  # wall clock on both sides (engine default)
+        slo=slo,
+        fault_specs=f"stall_decode@0:secs={stall_secs:g}",
+        report_path=report_path,
+        drain_grace_s=30.0,
+        label="serve_soak",
+    )
+
+    finished_total = [0]
+
+    def on_phase(rec):
+        finished_total[0] += rec["finished"]
+        partial.update(
+            phase=f"soak_{rec['phase']}",
+            iters_measured=finished_total[0],
+            metric="soak_goodput_tokens_per_s",
+            value=rec["goodput_tokens_per_s"], unit="tokens/s",
+        )
+
+    t_soak = time.perf_counter()
+    harness = SoakHarness(engine, soak_cfg, on_phase_end=on_phase)
+    report = harness.run()
+    soak_wall_s = time.perf_counter() - t_soak
+
+    head = report["headline"]
+    fault = report["fault"]
+    return {
+        "metric": "soak_goodput_tokens_per_s_at_slo",
+        "value": round(head["goodput_tokens_per_s_at_slo"] or 0.0, 1),
+        "unit": "tokens/s",
+        # acceptance bar: the soak phase (0.75x measured capacity) holds
+        # its p95 TTFT under the objective
+        "vs_baseline": (
+            round(ttft_obj / head["soak_p95_ttft_s"], 3)
+            if head["soak_p95_ttft_s"] else None
+        ),
+        "extra": {
+            "capacity_rps_closed_loop": round(capacity_rps, 2),
+            "capacity_rps_at_breach_point": round(
+                head["capacity_rps_at_breach_point"], 2
+            ),
+            "capacity_saturated": head["capacity_saturated"],
+            "slo_ok": head["slo_ok"],
+            "soak_p95_ttft_s": (
+                round(head["soak_p95_ttft_s"], 5)
+                if head["soak_p95_ttft_s"] is not None else None
+            ),
+            "ttft_objective_s": round(ttft_obj, 4),
+            "max_queue_delay_s": round(4.0 * ttft_obj, 4),
+            "shed_totals": report["shed_totals"],
+            "requests_planned": report["requests_planned"],
+            "requests_finished": report["requests_finished"],
+            "requests_shed": report["requests_shed"],
+            "arrival_lag_p95_s": report["arrival_lag"]["p95_s"],
+            "fault_specs": fault["specs"],
+            "fault_sheds_in_window": fault["sheds_in_window"],
+            "fault_slo_violations_in_window": (
+                fault["slo_violations_in_window"]
+            ),
+            "recovery_s": fault["recovery_s"],
+            "recovered": fault["recovered"],
+            "decode_retraces_after_warmup": report["decode_retraces"],
+            "engine_steps": report["engine_steps"],
+            "soak_wall_s": round(soak_wall_s, 3),
+            "calib_wall_s": round(calib_s, 3),
+            "unit_s": round(unit_s, 3),
+            "trace_sha256": report["trace_sha256"],
+            "phases": report["phases"],
+            "report_path": report_path,
+            "max_slots": max_slots,
+            "block_size": block_size,
+            "params": n_params,
+            "device": _device_kind(),
+        },
+    }
+
+
 def _run_overhead(cfg, batch_size: int, seq: int, iters: int, warmup: int,
                   partial: Optional[PartialWriter] = None):
     """Telemetry+diagnostics ON-vs-OFF A/B: the harness proving ITSELF
@@ -1477,6 +1709,17 @@ def result_line(variant, partial: Optional[PartialWriter] = None) -> dict:
             rec["extra"]["engine_wall_s"]
             + rec["extra"]["baseline_wall_s"]
             + rec["extra"]["obs_ab_wall_s"]
+        )
+    elif kind == "serve_soak":
+        max_slots, block_size, n_requests, seed = batch_size, seq, iters, warmup
+        rec = _run_serve_soak(
+            cfg, max_slots, block_size, n_requests, seed, partial=partial
+        )
+        rec["extra"].update(probe())
+        # the whole open-loop program plus its closed-loop calibration
+        # probe is real measured generation under load
+        productive_s = (
+            rec["extra"]["soak_wall_s"] + rec["extra"]["calib_wall_s"]
         )
     elif kind == "lora":
         rec = _run_lora(cfg, batch_size, seq, iters, warmup, partial=partial)

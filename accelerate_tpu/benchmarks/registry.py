@@ -25,7 +25,7 @@ ENV_ITERS = "ACCELERATE_TPU_BENCH_ITERS"  # test/debug: stretch train loops
 @dataclass(frozen=True)
 class Variant:
     name: str
-    kind: str  # "train" | "ckpt" | "accum" | "decode" | "decode_load" | "serve" | "overhead" | "lora"
+    kind: str  # "train" | "ckpt" | "accum" | "decode" | "decode_load" | "serve" | "serve_soak" | "overhead" | "lora"
     priority: int
     group: str
     args: tuple = field(default_factory=tuple)
@@ -161,6 +161,16 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
             # own program set plus a warmup + timed drain)
             _variant("serve", "serve", 3, "serve", (tiny, 4, 8, 16, 0),
                      default_estimate_s=240),
+            # soak & chaos: the loadgen harness drives the same tiny
+            # serving config through warmup->ramp->soak->fault->recovery
+            # on the wall clock (open-loop arrivals, stall_decode fault
+            # mid-soak). Rates self-calibrate from a closed-loop probe,
+            # so the ~10-25s program cost is host-independent; NOT fast
+            # because the wall-clock phases cannot be shrunk below the
+            # SLO windows. args: (cfg, max_slots, block_size,
+            # target_requests, seed)
+            _variant("serve_soak", "serve_soak", 4, "serve",
+                     (tiny, 4, 8, 96, 0), default_estimate_s=120),
             _variant("ckpt", "ckpt", 3, "ckpt", (tiny, 4, 64, 8, 2),
                      fast=True, default_estimate_s=15),
             # adapter-only vs full fine-tune economics + the multi-tenant
@@ -292,6 +302,10 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
         # (cfg, max_slots, block_size, n_requests, seed)
         _variant("serve", "serve", 3, "decode", (decode, 4, 16, 8, 0),
                  default_estimate_s=2000),
+        # soak & chaos on the ~5.5B decode model (same child process /
+        # resident compile budget); args mirror serve's
+        _variant("serve_soak", "serve_soak", 4, "decode",
+                 (decode, 4, 16, 64, 0), default_estimate_s=900),
         _variant("moe", "train", 3, "moe", (moe, 16, 1024, 20, 3),
                  default_estimate_s=600),
         _variant("longseq", "train", 3, "longseq", (longseq, 1, 8192, 8, 2),

@@ -273,6 +273,27 @@ class AnomalyDetector:
         )
         return [rec] if rec else []
 
+    def observe_soak(
+        self,
+        record: dict,
+        now: Optional[float] = None,
+    ) -> list[dict]:
+        """Check one ``kind="soak"`` record (a loadgen phase summary): a
+        phase that saw a burn breach becomes a ``soak_breach`` anomaly.
+        The harness already folded the SLO verdict per phase — this
+        routes it into the same rate-limited anomaly/capture machinery
+        as live ``slo_breach`` records, so a breached soak phase shows
+        up in the flight ring and `diagnose` like any other alarm."""
+        if record.get("kind") != "soak" or not record.get("breach"):
+            return []
+        now = time.monotonic() if now is None else now
+        rec = self._fire(
+            "soak_breach", record, now,
+            value=float(record.get("goodput_tokens_per_s") or 0.0),
+            phase=str(record.get("phase") or ""),
+        )
+        return [rec] if rec else []
+
     def summary(self) -> dict:
         return {
             "anomalies": dict(self.counts),

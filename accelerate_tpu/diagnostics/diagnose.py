@@ -268,6 +268,23 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
     except Exception:
         oom_report = None
 
+    # --- did a soak run against this job --------------------------------- #
+    # soak-report*.json files the loadgen harness wrote into the dump dir:
+    # the phase table, goodput-under-SLO headline and measured fault damage,
+    # keyed by the writing rank. Only present when a soak actually ran.
+    soak: dict[int, dict[str, Any]] = {}
+    try:
+        from ..loadgen.report import read_report as _read_soak
+
+        for name in sorted(os.listdir(dir)):
+            if not (name.startswith("soak-report") and name.endswith(".json")):
+                continue
+            rep = _read_soak(os.path.join(dir, name))
+            if rep is not None:
+                soak[int(rep.get("rank") or 0)] = rep
+    except Exception:
+        soak = {}
+
     return {
         "dir": dir,
         "num_ranks": len(ranks),
@@ -285,6 +302,7 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
         "heartbeat_stalls": stalls,
         "exceptions": exceptions,
         "serving": serving,
+        "soak": soak,
         "memory": memory,
         "top_ops": top_ops,
         "oom_report": oom_report,
@@ -453,6 +471,74 @@ def format_report(report: dict) -> str:
                         else ""
                     )
                 )
+    soak = report.get("soak") or {}
+    for rank in sorted(soak):
+        rep = soak[rank]
+        head = rep.get("headline") or {}
+        lines.append("")
+        lines.append(
+            f"SOAK (rank {rank}, seed {rep.get('seed')}, "
+            f"{rep.get('clock')} clock)"
+            + ("  [INTERRUPTED]" if rep.get("interrupted") else "")
+        )
+        lines.append(
+            f"  {'phase':<12} {'offered':>9} {'finished':>8} "
+            f"{'goodput':>11} {'p95_ttft':>10} {'shed':>5}  slo"
+        )
+        for p in rep.get("phases") or []:
+            p95 = p.get("p95_ttft_s")
+            lines.append(
+                f"  {str(p.get('phase')):<12} "
+                f"{p.get('offered_rps') or 0.0:>7.1f}/s "
+                f"{p.get('finished') or 0:>8} "
+                f"{p.get('goodput_tokens_per_s') or 0.0:>7.1f}tok/s "
+                + (f"{p95 * 1e3:>8.1f}ms " if p95 is not None
+                   else f"{'n/a':>10} ")
+                + f"{p.get('shed') or 0:>5}  "
+                + ("BREACH" if p.get("breached") else "ok")
+            )
+        goodput = head.get("goodput_tokens_per_s_at_slo")
+        obj = head.get("ttft_objective_s")
+        soak_p95 = head.get("soak_p95_ttft_s")
+        lines.append(
+            "  headline: goodput@SLO="
+            + (f"{goodput:.1f} tok/s" if goodput is not None else "n/a")
+            + (
+                f" (soak p95 TTFT {soak_p95 * 1e3:.1f}ms vs "
+                f"{obj * 1e3:.1f}ms objective, "
+                + ("met)" if head.get("slo_ok") else "MISSED)")
+                if soak_p95 is not None and obj is not None
+                else ""
+            )
+        )
+        cap = head.get("capacity_rps_at_breach_point")
+        if head.get("capacity_saturated"):
+            lines.append(
+                f"  capacity: >= {cap or 0.0:.1f} req/s (ramp never breached)"
+            )
+        elif cap:
+            lines.append(f"  capacity at breach point: {cap:.1f} req/s")
+        fault = rep.get("fault") or {}
+        if fault.get("specs"):
+            rec_s = fault.get("recovery_s")
+            lines.append(
+                "  fault: " + ", ".join(fault["specs"])
+                + f"  damage: sheds={fault.get('sheds_in_window') or 0}"
+                f" slo_violations={fault.get('slo_violations_in_window') or 0}"
+                + (
+                    f"  recovered in {rec_s:.2f}s"
+                    if rec_s is not None
+                    else "  NOT RECOVERED"
+                )
+            )
+        top_shed = sorted(
+            (rep.get("shed_totals") or {}).items(), key=lambda kv: -kv[1]
+        )[:3]
+        if top_shed:
+            lines.append(
+                "  top shed reasons: "
+                + " ".join(f"{r}={n}" for r, n in top_shed)
+            )
     memory = report.get("memory") or {}
     if memory:
         lines.append("")

@@ -94,6 +94,22 @@ class DiagnosticsManager:
                     if self.config.capture_on_anomaly:
                         self.capture.request("anomaly_slo_breach")
             return out
+        if kind == "soak":
+            # loadgen phase summaries: a breached soak phase raises the
+            # same alarm machinery as a live slo breach
+            out = []
+            if self.anomaly is not None:
+                for anom in self.anomaly.observe_soak(record):
+                    out.append(anom)
+                    self.recorder.event(
+                        "anomaly",
+                        anomaly_type=anom["anomaly_type"],
+                        value=anom.get("value"),
+                        phase=anom.get("phase"),
+                    )
+                    if self.config.capture_on_anomaly:
+                        self.capture.request("anomaly_soak_breach")
+            return out
         if kind == "memory":
             # the live-buffer census stream: the leak rule watches the
             # unowned bucket for monotone growth (same alarm/capture

@@ -1,0 +1,174 @@
+"""Chaos adapter: the fault-injection grammar pointed at a live engine.
+
+The PR 9/11 ``ACCELERATE_TPU_FAULT_INJECT`` grammar fires process-fatal
+faults (kill/hang) for the elastic supervisor tests. A soak needs the
+*serving* analogues — faults the engine is supposed to absorb, with the
+damage measured instead of hoped about:
+
+* ``stall_decode@step:secs=N`` — wedges the decode loop for N seconds.
+  The harness keeps injecting arrivals on schedule while stalled (the
+  open-loop contract), so the fault shows up as queue growth, arrival
+  lag, TTFT misses and burn — never as a flattened arrival process.
+* ``pool_pressure@step[:secs=N]`` — pins half the free KV blocks so
+  admission sees a nearly-exhausted pool; released after ``secs`` (or
+  at :meth:`ChaosAdapter.release`).
+* ``adapter_churn@step`` — loads a capacity-full wave of throwaway
+  adapters, evicting every unpinned resident tenant (in-flight tenants
+  are refcount-protected and survive — that invariant is part of what
+  the soak verifies). :meth:`release` invokes the ``restore`` callback
+  so the harness can re-load its tenants and recovery is measurable.
+
+Handlers install on a :class:`FaultInjector` via ``install_handler`` —
+spec *steps* are engine steps, and the soak harness shifts them to be
+relative to the fault window's entry step (``stall_decode@0`` = "at the
+window's first step").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..test_utils.fault_injection import (
+    SERVING_ACTIONS,
+    FaultInjector,
+    FaultSpec,
+)
+
+#: stall length when a spec omits ``secs=`` — a serving stall must
+#: always end (the process-fatal "forever" semantics belong to hang)
+DEFAULT_STALL_SECS = 1.0
+
+_MAX_EVENTS = 64  # bounded event log: a soak runs for minutes
+
+
+class ChaosAdapter:
+    """Installs serving-fault handlers on ``injector`` and tracks the
+    damage window. ``now`` is the harness clock (the same injectable
+    clock the engine stamps from); ``restore`` re-loads the harness's
+    tenant adapters after a churn."""
+
+    def __init__(
+        self,
+        engine,
+        injector: FaultInjector,
+        now: Callable[[], float],
+        restore: Optional[Callable[[], None]] = None,
+    ):
+        self.engine = engine
+        self.injector = injector
+        self._now = now
+        self._restore = restore
+        self._stall_until: float = float("-inf")
+        self._pinned_blocks: list = []
+        self._pin_release_at: Optional[float] = None
+        self._churned = False
+        self.events: list[dict] = []
+        for action in SERVING_ACTIONS:
+            injector.install_handler(action, getattr(self, "_on_" + action))
+
+    # ------------------------------------------------------------------ #
+    # the harness-facing surface
+    # ------------------------------------------------------------------ #
+    def stalled(self) -> bool:
+        """True while the decode loop is wedged — the harness skips
+        ``engine.step()`` but keeps submitting scheduled arrivals."""
+        return self._now() < self._stall_until
+
+    def poll(self) -> None:
+        """Cheap per-iteration upkeep: release expired block pins."""
+        if (
+            self._pin_release_at is not None
+            and self._now() >= self._pin_release_at
+        ):
+            self._release_pins()
+
+    def release(self) -> None:
+        """End the damage window: unpin blocks, restore churned
+        tenants, clear any residual stall. Idempotent — the harness
+        calls it at recovery entry AND from its ``finally``."""
+        self._release_pins()
+        self._stall_until = float("-inf")
+        if self._churned and self._restore is not None:
+            self._restore()
+            self._churned = False
+
+    def _event(self, action: str, **fields) -> None:
+        if len(self.events) < _MAX_EVENTS:
+            self.events.append(
+                {"action": action, "time_s": self._now(), **fields}
+            )
+
+    # ------------------------------------------------------------------ #
+    # handlers (called by FaultInjector._execute)
+    # ------------------------------------------------------------------ #
+    def _on_stall_decode(self, spec: FaultSpec) -> None:
+        secs = spec.stall_secs or DEFAULT_STALL_SECS
+        self._stall_until = self._now() + secs
+        self._event("stall_decode", step=spec.step, secs=secs)
+
+    def _on_pool_pressure(self, spec: FaultSpec) -> None:
+        pool = self.engine.pool
+        n = pool.num_free // 2
+        if n < 1:
+            self._event("pool_pressure", step=spec.step, pinned=0,
+                        skipped="no_free_blocks")
+            return
+        self._pinned_blocks.extend(pool.allocate(n))
+        if spec.stall_secs:
+            self._pin_release_at = self._now() + spec.stall_secs
+        self._event("pool_pressure", step=spec.step, pinned=n,
+                    secs=spec.stall_secs or None)
+
+    def _release_pins(self) -> None:
+        if self._pinned_blocks:
+            self.engine.pool.free(self._pinned_blocks)
+            self._event("pool_release", released=len(self._pinned_blocks))
+            self._pinned_blocks = []
+        self._pin_release_at = None
+
+    def _on_adapter_churn(self, spec: FaultSpec) -> None:
+        registry = getattr(self.engine, "adapters", None)
+        if registry is None:
+            self._event("adapter_churn", step=spec.step, loads=0,
+                        skipped="no_adapter_registry")
+            return
+        import numpy as np
+
+        from ..adapters.lora import LoraConfig, target_shapes
+
+        shapes = target_shapes(registry.model_config)
+        layers = registry.model_config.num_layers
+        cfg = LoraConfig(
+            rank=1, alpha=1.0, target_modules=registry.target_modules
+        )
+        params = {
+            t: {
+                "lora_a": np.zeros((layers, shapes[t][0], 1), np.float32),
+                "lora_b": np.zeros((layers, 1, shapes[t][1]), np.float32),
+            }
+            for t in registry.target_modules
+        }
+        evict_before = registry.evict_total
+        loads = 0
+        chaff = []
+        for i in range(registry.capacity + 1):
+            name = f"chaos-churn-{spec.step}-{i}"
+            try:
+                registry.load(name, params, cfg)
+            except RuntimeError:
+                break  # every row pinned by in-flight requests: bounded
+            chaff.append(name)
+            loads += 1
+        # clear our own chaff so rows are reusable; real tenants stay
+        # evicted until the harness's restore callback re-loads them
+        for name in chaff:
+            if registry.resident(name):
+                try:
+                    registry.evict(name)
+                except RuntimeError:
+                    pass
+        self._churned = bool(loads)
+        self._event(
+            "adapter_churn", step=spec.step, loads=loads,
+            evictions=registry.evict_total - evict_before,
+        )

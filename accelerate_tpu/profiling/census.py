@@ -53,7 +53,9 @@ class BufferCensus:
         self._lock = threading.Lock()
         self._owners: dict[str, Provider] = {}
         self.min_interval_s = float(min_interval_s)
-        self._last_sample_t: float = 0.0
+        # None (not 0.0) so the first sample always lands: monotonic's
+        # epoch is boot time, which can be < min_interval_s ago
+        self._last_sample_t: Optional[float] = None
         self._host_rss_peak = 0
         #: the most recent sample dict (what OOM forensics serializes —
         #: crash handlers must never take a fresh walk)
@@ -148,7 +150,11 @@ class BufferCensus:
         recent than ``min_interval_s`` (cadence callers pass through
         here so a hot loop with a small ``census_interval`` still can't
         spend more than one walk per interval of wall clock)."""
-        if not force and self.min_interval_s > 0:
+        if (
+            not force
+            and self.min_interval_s > 0
+            and self._last_sample_t is not None
+        ):
             if (
                 time.monotonic() - self._last_sample_t
                 < self.min_interval_s

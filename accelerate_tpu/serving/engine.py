@@ -47,7 +47,7 @@ from .scheduler import ContinuousScheduler, Request, Slot
 from .slo import SLOConfig, SloTracker
 from .spans import SpanLog, write_chrome_trace
 from .speculation import DraftModelProposer, NGramProposer, SpecConfig
-from .telemetry import ServeStats, percentile
+from .telemetry import ServeStats
 
 logger = get_logger(__name__)
 
@@ -891,13 +891,26 @@ class ServingEngine:
         records (host-side reads only — no device sync)."""
         now = self._now()
         sched = self.scheduler
-        ages = [now - r.submit_time for r in sched.queue]
+        # the queue is FIFO over one monotonic clock, so ages are sorted
+        # (oldest at the head) and the p95 reads straight off the index
+        # within 5% of the head — no O(n) list build per gauge sample
+        # (a 10k-deep backlog under soak made every sample an O(n) scan)
+        n_queued = len(sched.queue)
+        if n_queued:
+            rank = 0.95 * (n_queued - 1)
+            lo = int(rank)
+            hi = min(lo + 1, n_queued - 1)
+            a_lo = now - sched.queue[n_queued - 1 - lo].submit_time
+            a_hi = now - sched.queue[n_queued - 1 - hi].submit_time
+            queue_age_p95 = a_lo + (a_hi - a_lo) * (rank - lo)
+        else:
+            queue_age_p95 = 0.0
         pool = self.pool.stats()
         active = [s for s in sched.slots if s.busy]
         return {
             "engine_steps": self._steps,
-            "queue_depth": len(sched.queue),
-            "queue_age_p95_s": percentile(ages, 95) if ages else 0.0,
+            "queue_depth": n_queued,
+            "queue_age_p95_s": queue_age_p95,
             "slots_active": len(active),
             "slot_occupancy": len(active) / self.max_slots,
             "pool_blocks_free": pool["free"],

@@ -555,6 +555,15 @@ class StepTelemetry:
         diagnostics (they can trigger profile captures)."""
         return self._record_event("slo", label, fields)
 
+    def record_soak(self, *, label: str = "soak", **fields) -> Optional[dict]:
+        """Emit a ``kind="soak"`` record — the loadgen harness's
+        per-phase (and final) posture: offered vs. achieved rate,
+        goodput-under-SLO, arrival lag, sheds, breach flag. The
+        Prometheus sink renders numeric fields as
+        ``accelerate_tpu_loadgen_*`` gauges; ``breach=True`` records
+        route to the anomaly detector like SLO breaches."""
+        return self._record_event("soak", label, fields)
+
     # ------------------------------------------------------------------ #
     # reporting / lifecycle
     # ------------------------------------------------------------------ #

@@ -205,6 +205,28 @@ numeric fields become ``{prefix}_slo_{field}`` gauges)::
                                 objective (routed to the anomaly detector)
     breached_objectives  list   which objectives breached
 
+``kind="soak"`` (one per loadgen phase end plus a ``phase="final"``
+summary; numeric fields become ``{prefix}_loadgen_{field}`` gauges —
+offered vs. achieved rate and arrival lag for the open-loop soak
+harness)::
+
+    phase                str    phase name ("warmup", "ramp-2", "soak",
+                                "fault", "recovery", "final")
+    phase_kind           str    the phase's semantic kind
+    offered_rps          float  the arrival process's configured rate
+    achieved_rps         float  finished requests / phase duration
+    goodput_tokens_per_s float  tokens/s counting only requests whose
+                                TTFT met the objective
+    arrival_lag_p95_s    float  p95 of (actual submit - scheduled
+                                arrival) — the coordinated-omission
+                                guard made visible
+    shed                 int    requests shed during the phase
+    slo_violations       int    finished requests missing the objective
+    breach               bool   multi-window burn breach seen in phase
+                                (routed to the anomaly detector)
+    capacity_rps_at_breach_point float? (final record) ramp headline
+    recovery_s           float? (final record) fault time-to-recover
+
 ``kind="goodput"`` (every ``goodput_interval`` steps when diagnostics is
 on; the wall-clock attribution fold)::
 
@@ -391,6 +413,11 @@ class PrometheusTextSink(TelemetrySink):
             return
         if kind == "slo":
             self._emit_slo(record)
+            return
+        if kind == "soak":
+            # loadgen posture: offered vs. achieved rate, goodput under
+            # SLO, arrival lag — the open-loop harness's live gauges
+            self._emit_prefixed_gauges(record, "loadgen")
             return
         if kind == "shed":
             reason = str(record.get("reason", "unknown"))

@@ -20,6 +20,18 @@ Spec grammar (``;``-separated specs, each ``action@step[:key=val...]``):
   slice-level scenario explicit).
 * ``@step``: fire when :meth:`FaultInjector.maybe_fire` is called with
   exactly this step.
+Serving-scoped actions point the same grammar at a live serving engine
+instead of the process: ``stall_decode`` (``secs=N`` wedges the decode
+loop for N seconds — arrivals keep queueing, which is exactly the
+coordinated-omission scenario the loadgen harness measures),
+``pool_pressure`` (pins a slab of free KV blocks so admission feels a
+full pool) and ``adapter_churn`` (thrashes adapter-registry residency).
+These never touch signals or sleep: they dispatch to a handler the
+soak harness's :class:`~accelerate_tpu.loadgen.chaos.ChaosAdapter`
+installs via :meth:`FaultInjector.install_handler`, and are silently
+skipped when no handler is installed (a training script that calls
+``maybe_fire`` can never be wedged by a serving spec).
+
 * ``rank=R`` (default 0): only this process index fires.
 * ``slice=S``: only ranks whose fault domain (slice id, from the
   ``ACCELERATE_TPU_FAULT_DOMAIN`` env the elastic supervisor exports) is
@@ -46,7 +58,15 @@ from ..utils.constants import ENV_PREFIX
 
 FAULT_ENV = ENV_PREFIX + "FAULT_INJECT"
 
-_ACTIONS = ("kill", "sigterm", "sigint", "hang", "dcn_stall")
+#: serving-scoped actions: dispatched to an installed handler (the soak
+#: harness's ChaosAdapter), never to signals/sleeps — non-fatal by
+#: construction
+SERVING_ACTIONS = ("stall_decode", "pool_pressure", "adapter_churn")
+
+_ACTIONS = ("kill", "sigterm", "sigint", "hang", "dcn_stall") + SERVING_ACTIONS
+
+#: actions whose ``secs=`` field bounds a stall duration
+_TIMED_ACTIONS = ("dcn_stall", "stall_decode", "pool_pressure")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,9 +98,10 @@ class FaultSpec:
                     f"bad fault spec {text!r}: unknown field {part!r}"
                 )
             fields[key] = float(val) if key == "secs" else int(val)
-        if fields["secs"] and action != "dcn_stall":
+        if fields["secs"] and action not in _TIMED_ACTIONS:
             raise ValueError(
-                f"bad fault spec {text!r}: secs= only applies to dcn_stall"
+                f"bad fault spec {text!r}: secs= only applies to "
+                f"{'/'.join(_TIMED_ACTIONS)}"
             )
         return cls(
             action=action,
@@ -137,6 +158,19 @@ class FaultInjector:
         self.generation = generation
         self.fault_domain = fault_domain
         self._fired: set[FaultSpec] = set()
+        self._handlers: dict = {}  # serving action -> callable(spec)
+
+    def install_handler(self, action: str, handler) -> None:
+        """Route a serving-scoped action to ``handler(spec)`` instead of
+        the process-fatal paths. Only :data:`SERVING_ACTIONS` may be
+        handled — rewiring ``kill`` would let a test pass while the
+        scenario it claims to exercise never ran."""
+        if action not in SERVING_ACTIONS:
+            raise ValueError(
+                f"only serving actions {SERVING_ACTIONS} take handlers, "
+                f"got {action!r}"
+            )
+        self._handlers[action] = handler
 
     @classmethod
     def from_env(cls, env_var: str = FAULT_ENV, **kwargs) -> "FaultInjector":
@@ -165,6 +199,11 @@ class FaultInjector:
                 self._execute(spec)
 
     def _execute(self, spec: FaultSpec) -> None:
+        if spec.action in SERVING_ACTIONS:
+            handler = self._handlers.get(spec.action)
+            if handler is not None:
+                handler(spec)
+            return  # unhandled serving faults are inert, never fatal
         if spec.action == "kill":
             os.kill(os.getpid(), signal.SIGKILL)
         elif spec.action == "sigterm":

@@ -1,0 +1,553 @@
+"""Soak & chaos harness tests (accelerate_tpu.loadgen).
+
+Host-only unit tests cover the deterministic trace, the open-loop
+coordinated-omission guard on a fake clock/engine, the serving-scoped
+fault grammar + chaos handlers, the SLO tracker's single-pass window
+fold, the atomic report, and the diagnose SOAK section. One slow-marked
+end-to-end smoke drives a REAL ServingEngine on the virtual clock
+through the full ramp->soak->fault->recovery program and asserts the
+bounded-damage / zero-retrace / bounded-memory contract.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.loadgen import (
+    ChaosAdapter,
+    Phase,
+    SoakClock,
+    SoakConfig,
+    SoakHarness,
+    WorkloadConfig,
+    build_trace,
+    lag_histogram,
+    phase_bounds,
+    read_report,
+    standard_program,
+    total_duration_s,
+    trace_fingerprint,
+    write_report,
+)
+from accelerate_tpu.test_utils.fault_injection import (
+    SERVING_ACTIONS,
+    FaultInjector,
+    FaultSpec,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+class FakeEngine:
+    """Minimal duck-typed engine: completes ``tokens_per_step`` request-
+    tokens per step, optionally sleeping ``step_sleep_s`` of REAL time
+    per step (the wedged-engine scenario for the wall-clock CO test)."""
+
+    def __init__(self, tokens_per_step=4, step_sleep_s=0.0):
+        self.tokens_per_step = tokens_per_step
+        self.step_sleep_s = step_sleep_s
+        self.active = []
+        self.added = []
+        self.steps = 0
+
+    @property
+    def has_work(self):
+        return bool(self.active)
+
+    def add_request(self, prompt, max_new_tokens=16, adapter=None,
+                    request_id=None):
+        self.added.append(request_id)
+        self.active.append([request_id, int(max_new_tokens)])
+        return request_id
+
+    def step(self):
+        self.steps += 1
+        if self.step_sleep_s:
+            time.sleep(self.step_sleep_s)
+        budget = self.tokens_per_step
+        for row in list(self.active):
+            if budget <= 0:
+                break
+            row[1] -= 1
+            budget -= 1
+            if row[1] <= 0:
+                self.active.remove(row)
+
+
+# --------------------------------------------------------------------- #
+# workload / phases
+# --------------------------------------------------------------------- #
+class TestTrace:
+    def test_same_seed_identical_trace(self):
+        wl = WorkloadConfig()
+        phases = standard_program(soak_s=2.0, fault_s=0.0, recovery_s=0.0)
+        a = build_trace(wl, phases, seed=3)
+        b = build_trace(wl, phases, seed=3)
+        assert a == b
+        assert trace_fingerprint(a) == trace_fingerprint(b)
+        assert trace_fingerprint(a) != trace_fingerprint(
+            build_trace(wl, phases, seed=4)
+        )
+
+    def test_arrivals_ordered_and_phase_bound(self):
+        wl = WorkloadConfig()
+        phases = standard_program()
+        trace = build_trace(wl, phases, seed=0)
+        assert trace, "standard program must offer load"
+        total = total_duration_s(phases)
+        bounds = {p.name: (s, e) for p, s, e in phase_bounds(phases)}
+        last = 0.0
+        for req in trace:
+            assert 0.0 <= req.arrival_s < total
+            assert req.arrival_s >= last
+            last = req.arrival_s
+            start, end = bounds[req.phase]
+            assert start <= req.arrival_s < end
+
+    def test_cohort_prefix_sharing(self):
+        wl = WorkloadConfig(cohort_fraction=1.0)
+        trace = build_trace(
+            wl, (Phase("p", "soak", 4.0, 8.0),), seed=1
+        )
+        by_cohort = {}
+        for req in trace:
+            assert req.cohort is not None
+            by_cohort.setdefault(req.cohort, []).append(req.prompt)
+        shared = False
+        for prompts in by_cohort.values():
+            if len(prompts) < 2:
+                continue
+            head = prompts[0][: wl.prefix_tokens]
+            assert all(p[: wl.prefix_tokens] == head for p in prompts)
+            shared = True
+        assert shared, "cohorted trace must share templated prefixes"
+
+    def test_token_budget_respected(self):
+        wl = WorkloadConfig(max_total_tokens=32)
+        trace = build_trace(wl, (Phase("p", "soak", 4.0, 16.0),), seed=2)
+        for req in trace:
+            assert len(req.prompt) + req.max_new_tokens <= 32
+
+    def test_phase_validation(self):
+        with pytest.raises(ValueError):
+            Phase("x", "nope", 1.0, 1.0)
+        with pytest.raises(ValueError):
+            Phase("x", "soak", -1.0, 1.0)
+        with pytest.raises(ValueError):
+            Phase("x", "soak", 1.0, 1.0, process="bursty")
+
+
+# --------------------------------------------------------------------- #
+# open-loop arrivals: the coordinated-omission guard
+# --------------------------------------------------------------------- #
+class TestOpenLoop:
+    def test_slow_engine_cannot_slow_arrivals(self):
+        """A fake engine that barely finishes anything: every planned
+        request is still SUBMITTED (offered == planned), which is
+        exactly what a closed-loop generator would not do."""
+        wl = WorkloadConfig(output_tokens_min=64, output_tokens_median=64,
+                            output_tokens_max=64, tail_alpha=8.0,
+                            max_total_tokens=None)
+        phases = (Phase("burst", "soak", 1.0, 32.0, process="uniform"),)
+        engine = FakeEngine(tokens_per_step=1)
+        cfg = SoakConfig(workload=wl, phases=phases, seed=0,
+                         step_dt_s=0.01, drain_grace_s=0.5)
+        report = SoakHarness(engine, cfg).run()
+        planned = len(build_trace(wl, phases, 0))
+        assert report["requests_planned"] == planned
+        assert report["requests_submitted"] == planned
+        assert report["requests_finished"] < planned
+        assert report["stop_reason"] == "drain_timeout"
+
+    def test_wall_clock_stall_recorded_as_arrival_lag(self):
+        """Wall clock + an engine that sleeps 50ms per step: arrivals
+        scheduled every 12.5ms get submitted late and the lateness is
+        RECORDED as arrival lag (not silently absorbed into stretched
+        inter-arrival gaps — the trace is fixed up front)."""
+        wl = WorkloadConfig(output_tokens_min=2, output_tokens_median=2,
+                            output_tokens_max=4)
+        phases = (Phase("burst", "soak", 0.25, 80.0, process="uniform"),)
+        engine = FakeEngine(tokens_per_step=64, step_sleep_s=0.05)
+        cfg = SoakConfig(workload=wl, phases=phases, seed=0,
+                         step_dt_s=None, drain_grace_s=5.0)
+        report = SoakHarness(engine, cfg).run()
+        assert report["clock"] == "wall"
+        assert report["requests_submitted"] == report["requests_planned"]
+        # the 50ms step stalls are visible damage on the lag histogram
+        assert report["arrival_lag"]["max_s"] > 0.01
+        # and the schedule itself never stretched: same seed, same trace
+        assert report["trace_sha256"] == trace_fingerprint(
+            build_trace(wl, phases, 0)
+        )
+
+    def test_mid_run_abort_still_writes_report(self, tmp_path):
+        """Satellite: a run killed mid-burn still lands a parseable
+        report with the drain-edge SLO snapshot and cumulative sheds."""
+        from accelerate_tpu.serving import SLOConfig
+        from accelerate_tpu.serving.slo import SloTracker
+
+        class DyingEngine(FakeEngine):
+            def step(self):
+                super().step()
+                if self.steps >= 5:
+                    raise RuntimeError("boom")
+
+        engine = DyingEngine()
+        engine.slo_tracker = SloTracker(SLOConfig())
+
+        class Stats:
+            shed_counts = {"queue_full": 3}
+
+        engine.stats = Stats()
+        path = str(tmp_path / "soak-report.json")
+        cfg = SoakConfig(
+            workload=WorkloadConfig(),
+            phases=(Phase("soak", "soak", 5.0, 16.0),),
+            seed=0, step_dt_s=0.01, report_path=path,
+        )
+        with pytest.raises(RuntimeError, match="boom"):
+            SoakHarness(engine, cfg).run()
+        report = read_report(path)
+        assert report is not None
+        assert report["interrupted"] is True
+        assert report["slo_final"] is not None
+        assert report["shed_totals"] == {"queue_full": 3}
+        assert report["phases"], "the partial phase must still close"
+
+
+# --------------------------------------------------------------------- #
+# fault grammar + chaos handlers
+# --------------------------------------------------------------------- #
+class TestChaos:
+    def test_serving_spec_roundtrip(self):
+        spec = FaultSpec.parse("stall_decode@3:secs=2.5")
+        assert spec.action == "stall_decode"
+        assert spec.step == 3 and spec.stall_secs == 2.5
+        assert FaultSpec.parse(spec.render()) == spec
+
+    def test_secs_rejected_on_untimed_actions(self):
+        with pytest.raises(ValueError, match="secs"):
+            FaultSpec.parse("adapter_churn@1:secs=2")
+        with pytest.raises(ValueError, match="secs"):
+            FaultSpec.parse("kill@1:secs=2")
+
+    def test_unhandled_serving_action_is_inert(self):
+        inj = FaultInjector(
+            [FaultSpec.parse("stall_decode@0:secs=1")], rank=0, generation=0
+        )
+        inj.maybe_fire(0)  # no handler installed: must not raise/signal
+
+    def test_handler_dispatch_and_fatal_actions_refused(self):
+        fired = []
+        inj = FaultInjector(
+            [FaultSpec.parse("pool_pressure@2")], rank=0, generation=0
+        )
+        inj.install_handler("pool_pressure", lambda spec: fired.append(spec))
+        with pytest.raises(ValueError):
+            inj.install_handler("kill", lambda spec: None)
+        inj.maybe_fire(1)
+        assert not fired
+        inj.maybe_fire(2)
+        assert [s.action for s in fired] == ["pool_pressure"]
+        inj.maybe_fire(2)  # at most once per spec
+        assert len(fired) == 1
+
+    def test_stall_and_pool_pressure_on_fake_clock(self):
+        from accelerate_tpu.serving import BlockPool
+
+        clock = FakeClock()
+        engine = FakeEngine()
+        engine.pool = BlockPool(num_blocks=32, block_size=8)
+        inj = FaultInjector([], rank=0, generation=0)
+        chaos = ChaosAdapter(engine, inj, clock)
+        assert set(inj._handlers) == set(SERVING_ACTIONS)
+
+        chaos._on_stall_decode(FaultSpec.parse("stall_decode@0:secs=2"))
+        assert chaos.stalled()
+        clock.tick(2.5)
+        assert not chaos.stalled()
+
+        free_before = engine.pool.num_free
+        chaos._on_pool_pressure(FaultSpec.parse("pool_pressure@0"))
+        assert engine.pool.num_free == free_before - free_before // 2
+        chaos.release()
+        assert engine.pool.num_free == free_before
+        chaos.release()  # idempotent
+        assert engine.pool.num_free == free_before
+        assert any(e["action"] == "pool_pressure" for e in chaos.events)
+
+    def test_adapter_churn_evicts_and_restores(self):
+        from accelerate_tpu.adapters import AdapterRegistry
+        from accelerate_tpu.models import TransformerConfig
+
+        cfg = TransformerConfig.tiny(max_seq_len=32)
+        registry = AdapterRegistry(cfg, capacity=3)
+        engine = FakeEngine()
+        engine.adapters = registry
+        restored = []
+        inj = FaultInjector([], rank=0, generation=0)
+        chaos = ChaosAdapter(
+            engine, inj, FakeClock(), restore=lambda: restored.append(1)
+        )
+        chaos._on_adapter_churn(FaultSpec.parse("adapter_churn@0"))
+        assert registry.evict_total > 0
+        assert not any(
+            n.startswith("chaos-churn") for n in registry.resident_names()
+        )
+        chaos.release()
+        assert restored == [1]
+
+
+# --------------------------------------------------------------------- #
+# SLO tracker: single-pass window fold (satellite perf fix)
+# --------------------------------------------------------------------- #
+def test_slo_tracker_single_pass_matches_brute_force():
+    from accelerate_tpu.serving import SLOConfig
+    from accelerate_tpu.serving.slo import SloTracker
+
+    cfg = SLOConfig(
+        ttft_objective_s=0.1, e2e_objective_s=1.0, target=0.9,
+        fast_window_s=5.0, slow_window_s=20.0, min_requests=1,
+    )
+    tracker = SloTracker(cfg)
+    rng = np.random.default_rng(0)
+    t, events = 0.0, []
+    for _ in range(400):
+        t += float(rng.exponential(0.2))
+        ttft = float(rng.exponential(0.1))
+        e2e = float(rng.exponential(0.8))
+        events.append((t, ttft, e2e))
+        tracker.observe(t, ttft, e2e)
+    snap = tracker.snapshot(t)
+    for span, key in ((cfg.fast_window_s, "fast"), (cfg.slow_window_s, "slow")):
+        window = [e for e in events if e[0] >= t - span]
+        n = len(window)
+        assert snap[f"requests_{key}_window"] == n
+        for obj, bound, idx in (("ttft", 0.1, 1), ("e2e", 1.0, 2)):
+            errors = sum(1 for e in window if e[idx] > bound)
+            expect = (errors / n) / (1.0 - cfg.target)
+            assert snap[f"{obj}_burn_{key}"] == pytest.approx(expect)
+
+
+# --------------------------------------------------------------------- #
+# report plumbing
+# --------------------------------------------------------------------- #
+class TestReport:
+    def test_atomic_roundtrip(self, tmp_path):
+        path = str(tmp_path / "sub" / "soak-report.json")
+        write_report(path, {"version": 1, "rank": 0, "x": (1, 2)})
+        assert read_report(path) == {"version": 1, "rank": 0, "x": [1, 2]}
+        assert read_report(str(tmp_path / "missing.json")) is None
+        bad = tmp_path / "bad.json"
+        bad.write_text("{nope")
+        assert read_report(str(bad)) is None
+
+    def test_lag_histogram_buckets(self):
+        h = lag_histogram([0.0005, 0.005, 0.5, 20.0])
+        assert h["count"] == 4
+        assert h["max_s"] == 20.0
+        assert h["histogram"]["le_0.001s"] == 1
+        assert h["histogram"]["le_0.01s"] == 1
+        assert h["histogram"]["le_1s"] == 1
+        assert h["histogram"]["gt_10s"] == 1
+        assert lag_histogram([])["count"] == 0
+
+    def test_record_soak_prometheus_gauges(self):
+        from accelerate_tpu.telemetry import PrometheusTextSink, StepTelemetry
+
+        tel = StepTelemetry(True)
+        sink = PrometheusTextSink(path=None)
+        tel.add_sink(sink)
+        tel.record_soak(
+            phase="soak", phase_kind="soak", offered_rps=8.0,
+            achieved_rps=7.5, goodput_tokens_per_s=120.0,
+            arrival_lag_p95_s=0.01, shed=2, slo_violations=1,
+            breach=False,
+        )
+        text = sink.render()
+        assert "accelerate_tpu_loadgen_goodput_tokens_per_s" in text
+        assert "accelerate_tpu_loadgen_offered_rps" in text
+        assert "accelerate_tpu_loadgen_shed" in text
+        tel.close()
+
+    def test_soak_breach_routes_to_anomaly(self):
+        from accelerate_tpu.diagnostics.anomaly import AnomalyDetector
+        from accelerate_tpu.diagnostics.config import DiagnosticsConfig
+
+        det = AnomalyDetector(DiagnosticsConfig())
+        quiet = det.observe_soak(
+            {"kind": "soak", "phase": "soak", "breach": False}
+        )
+        assert quiet == []
+        fired = det.observe_soak({
+            "kind": "soak", "phase": "ramp-3", "breach": True,
+            "goodput_tokens_per_s": 42.0,
+        })
+        assert len(fired) == 1
+        assert fired[0]["anomaly_type"] == "soak_breach"
+        assert fired[0]["phase"] == "ramp-3"
+
+    def test_diagnose_soak_section(self, tmp_path):
+        from accelerate_tpu.diagnostics import build_report, format_report
+
+        report = {
+            "version": 1, "kind": "soak_report", "rank": 0, "seed": 7,
+            "clock": "virtual", "interrupted": False,
+            "headline": {
+                "goodput_tokens_per_s_at_slo": 73.0,
+                "soak_p95_ttft_s": 0.11, "ttft_objective_s": 0.5,
+                "slo_ok": True, "capacity_rps_at_breach_point": 16.0,
+                "capacity_saturated": False,
+            },
+            "phases": [{
+                "phase": "soak", "kind": "soak", "offered": 8,
+                "offered_rps": 12.0, "finished": 14, "shed": 1,
+                "goodput_tokens_per_s": 73.0, "p95_ttft_s": 0.11,
+                "breached": False,
+            }],
+            "fault": {
+                "specs": ["stall_decode@0:rank=0:gen=0:secs=0.2"],
+                "sheds_in_window": 2, "slo_violations_in_window": 3,
+                "recovery_s": 0.09, "recovered": True,
+            },
+            "shed_totals": {"queue_full": 4, "queue_deadline": 1},
+        }
+        write_report(str(tmp_path / "soak-report.json"), report)
+        built = build_report(str(tmp_path))
+        assert built["soak"][0]["headline"]["capacity_rps_at_breach_point"] == 16.0
+        text = format_report(built)
+        assert "SOAK (rank 0" in text
+        assert "goodput@SLO=73.0 tok/s" in text
+        assert "capacity at breach point: 16.0 req/s" in text
+        assert "recovered in 0.09s" in text
+        assert "queue_full=4" in text
+
+    def test_diagnose_without_soak_report(self, tmp_path):
+        from accelerate_tpu.diagnostics import build_report, format_report
+
+        built = build_report(str(tmp_path))
+        assert built["soak"] == {}
+        assert "SOAK" not in format_report(built)
+
+
+# --------------------------------------------------------------------- #
+# end-to-end smoke: real engine, virtual clock, full phase program
+# --------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    return cfg, model, params
+
+
+@pytest.mark.slow
+def test_soak_smoke_end_to_end(tiny_model, tmp_path):
+    """The ISSUE's acceptance path: a seeded ramp->soak->fault->recovery
+    program against a REAL engine on the virtual clock produces a
+    populated soak-report.json with measured recovery time and bounded
+    fault damage, zero decode retraces after warmup, a reproducible
+    trace, and bounded memory in every ring the run touched."""
+    from accelerate_tpu.serving import SLOConfig, ServingEngine
+    from accelerate_tpu.telemetry import StepTelemetry
+
+    cfg, model, params = tiny_model
+    clock = SoakClock()
+    tel = StepTelemetry(True)
+    engine = ServingEngine(
+        model, params, max_slots=2, block_size=8, now=clock,
+        max_retained_results=64,
+    )
+    wl = WorkloadConfig(
+        vocab_size=cfg.vocab_size, prompt_tokens_min=2,
+        prompt_tokens_median=4, prompt_tokens_max=16,
+        output_tokens_min=2, output_tokens_median=4, output_tokens_max=12,
+        max_total_tokens=48,
+    )
+    phases = standard_program(
+        warmup_s=0.5, warmup_rps=4.0, ramp_rates=(8.0, 16.0, 32.0, 64.0),
+        ramp_step_s=0.5, soak_s=1.0, soak_rps=12.0,
+        fault_s=0.5, recovery_s=1.0,
+    )
+    # tight objective so the top ramp rates genuinely breach: the
+    # capacity-at-breach-point headline is a real measurement, not a
+    # saturated "never broke" answer
+    slo = SLOConfig(
+        ttft_objective_s=0.05, e2e_objective_s=0.5, target=0.9,
+        fast_window_s=0.1, slow_window_s=0.25, burn_threshold=1.0,
+        interval_steps=4, min_requests=3,
+    )
+    report_path = str(tmp_path / "soak-report.json")
+    soak_cfg = SoakConfig(
+        workload=wl, phases=phases, seed=7, step_dt_s=0.01, slo=slo,
+        fault_specs="stall_decode@0:secs=0.2", report_path=report_path,
+        drain_grace_s=10.0,
+    )
+    harness = SoakHarness(engine, soak_cfg, clock=clock, telemetry=tel)
+    report = harness.run()
+    tel.close()
+
+    # the report landed on disk, atomically, and parses back
+    on_disk = read_report(report_path)
+    assert on_disk is not None
+    assert on_disk["trace_sha256"] == report["trace_sha256"]
+
+    # every planned request was offered (open loop) and accounted for
+    assert report["requests_submitted"] == report["requests_planned"] > 0
+    assert (
+        report["requests_finished"] + report["requests_shed"]
+        == report["requests_submitted"]
+    )
+    assert not report["interrupted"]
+
+    # headline: goodput under SLO measured during the soak phase, and a
+    # real breach point found somewhere on the ramp
+    head = report["headline"]
+    assert head["goodput_tokens_per_s_at_slo"] > 0
+    assert head["soak_p95_ttft_s"] is not None
+    assert not head["capacity_saturated"]
+    assert 0 < head["capacity_rps_at_breach_point"] < 64.0
+
+    # the fault fired, did bounded damage, and the engine recovered
+    fault = report["fault"]
+    assert fault["events"] and fault["events"][0]["action"] == "stall_decode"
+    assert fault["recovered"] and fault["recovery_s"] is not None
+    assert 0.0 <= fault["recovery_s"] < 1.0
+    recovery = report["phases"][-1]
+    assert recovery["kind"] == "recovery"
+    assert not recovery["breached"], "the burn must clear after the fault"
+
+    # zero decode retraces across the whole program (trace-counter bar)
+    assert report["decode_retraces"] == 0
+
+    # bounded memory: every ring the soak exercised stayed within its
+    # configured bound (the 10k-request audit in miniature)
+    assert len(engine.span_log.closed) <= engine.span_log.closed.maxlen
+    assert len(engine.stats.requests) <= engine.stats.requests.maxlen
+    assert len(engine._results) <= 64
+    assert (
+        len(engine.slo_tracker._events) < report["requests_finished"]
+    ), "the SLO deque must prune to its slow window"
+
+    # same seed -> bitwise-identical trace
+    assert trace_fingerprint(build_trace(wl, phases, 7)) == (
+        report["trace_sha256"]
+    )
