@@ -34,12 +34,13 @@ FAST_FILES = \
   tests/test_elastic.py tests/test_fused_kernels.py \
   tests/test_slice_mesh.py tests/test_adapters.py \
   tests/test_prefix_cache.py tests/test_speculation.py \
-  tests/test_profiling.py tests/test_loadgen.py
+  tests/test_profiling.py tests/test_loadgen.py \
+  tests/test_capacity.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
   diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke \
   slice-smoke kernels-smoke lora-smoke prefix-smoke spec-smoke mem-smoke \
-  soak-smoke
+  soak-smoke capacity-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -191,6 +192,16 @@ mem-smoke:
 	  tests/test_profiling.py::test_warmup_registers_program_and_ledger_sums \
 	  tests/test_profiling.py::test_census_owner_attribution_on_warmed_step \
 	  tests/test_profiling.py::test_oom_autopsy_survives_crashing_subprocess
+
+# capacity acceptance on CPU (~30s): chunked prefill decodes greedy-
+# bitwise vs the unchunked engine under a per-step token budget with
+# zero decode retraces and SRPT ordering, a mid-prefill stall preempts
+# instead of wedging, preempt/swap-out/swap-in round-trips KV blocks
+# bitwise through host memory with resumed outputs identical, the pool
+# swap-ledger fuzz leaks nothing, and int8 paged KV holds >= 1.8x the
+# seats by arithmetic while matching greedy outputs
+capacity-smoke:
+	JAX_PLATFORMS=cpu $(PYTEST) -q tests/test_capacity.py
 
 # soak & chaos acceptance on CPU (~30s): the whole loadgen unit tier
 # (deterministic trace, coordinated-omission guard, chaos handlers, SLO

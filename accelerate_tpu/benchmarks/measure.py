@@ -1093,6 +1093,14 @@ def _run_serve_soak(cfg, max_slots: int, block_size: int,
     Headline: goodput tokens/s during the soak phase counting only
     requests whose TTFT met the objective. ``vs_baseline`` is
     objective / soak-p95-TTFT (>= 1 means the soak rate held the SLO).
+
+    After the main soak, three short paired A/B arms measure the PR 17
+    capacity levers on identical traces: chunked prefill OFF/ON over a
+    long-prompt-burst mix (soak p95 TTFT must improve, zero retraces),
+    shed-only vs preemption under ``pool_pressure`` chaos (fault-window
+    sheds must drop; resumed outputs bitwise-match the control), and
+    fp-vs-int8 KV (census-verified ``kv_cache`` bytes fund >= 1.8x the
+    seats at fixed HBM; greedy outputs identical).
     """
     import os
 
@@ -1252,6 +1260,271 @@ def _run_serve_soak(cfg, max_slots: int, block_size: int,
     report = harness.run()
     soak_wall_s = time.perf_counter() - t_soak
 
+    # --- capacity A/B axes (PR 17) --------------------------------- #
+    # three short paired arms over IDENTICAL traces (same workload +
+    # seed), each isolating one serve-more-users-per-chip lever. The
+    # chunked and preemption arms run on the VIRTUAL clock (step_dt_s):
+    # TTFT and deadline aging are measured in engine steps, so the
+    # comparison captures the SCHEDULING change — which is what these
+    # levers are — instead of host speed and compile-pause noise.
+    #   chunked  — long-prompt-burst mix on a CONSTRAINED pool, plain
+    #              engine vs chunked prefill (+ its chunk-aware
+    #              admission reservation, which needs the preemption
+    #              escape hatch): soak-phase TTFT p95 must improve —
+    #              the OFF arm's FIFO head can't fund a giant's full
+    #              footprint and head-of-line-blocks admission until
+    #              the pool half-drains; the ON arm admits on the first
+    #              chunk and grows per chunk. Zero decode retraces;
+    #   preempt  — pool_pressure chaos at ramp-past-capacity rate,
+    #              shed-only vs preemption ON: fault-window sheds must
+    #              drop (sheds become pauses) and resumed outputs
+    #              bitwise-match the shed-only control;
+    #   int8     — same pool geometry fp vs int8 KV: census-verified
+    #              kv_cache owner bytes fund >= 1.8x the seats at a
+    #              fixed HBM budget, greedy outputs identical.
+    from dataclasses import replace as _dc_replace
+
+    from accelerate_tpu.loadgen import SoakClock
+    from accelerate_tpu.serving.engine import _next_pow2
+    from accelerate_tpu.telemetry import StepTelemetry
+
+    ab_dt = 0.01  # virtual seconds per engine step
+    # analytic seat throughput in requests per VIRTUAL second: a median
+    # request holds its seat ~ (prefill + median output) steps
+    vcap = max_slots / ((2 + workload.output_tokens_median) * ab_dt)
+
+    def _arm_engine(**kw):
+        clock = SoakClock()
+        eng = ServingEngine(
+            model, params, max_slots=max_slots, block_size=block_size,
+            now=clock, **kw,
+        )
+        return eng, clock
+
+    def _prime(eng, lens):
+        """Compile every program the arm's trace can hit BEFORE the
+        measured window (pow2 prefill buckets, chunk buckets, decode) —
+        the virtual clock hides compile pauses from TTFT, but priming
+        keeps the arms' step loops doing identical work."""
+        rng_p = np.random.default_rng(seed + 99)
+        for n in lens:
+            eng.add_request(
+                rng_p.integers(1, workload.vocab_size, size=n).tolist(),
+                max_new_tokens=2,
+            )
+        while eng.has_work:
+            eng.step()
+        from accelerate_tpu.serving.telemetry import ServeStats
+        eng.stats = ServeStats()
+
+    def _arm_report(name, eng, clock, workload_arm, phases, fault="",
+                    step_cost=None):
+        arm_path = (
+            os.path.join(
+                os.path.dirname(partial.path), f"soak-report-{name}.json"
+            ) if partial.path else None
+        )
+        arm_cfg = SoakConfig(
+            workload=workload_arm, phases=phases, seed=seed + 17,
+            step_dt_s=ab_dt, step_cost=step_cost, fault_specs=fault,
+            report_path=arm_path, drain_grace_s=60.0,
+            label=f"serve_soak_{name}",
+        )
+        rep = SoakHarness(eng, arm_cfg, clock=clock).run()
+        partial.update(phase=f"ab_{name}", iters_measured=finished_total[0])
+        return rep
+
+    def _soak_p95(rep):
+        for p in rep["phases"]:
+            if p["phase"] == "soak":
+                return p["p95_ttft_s"]
+        return None
+
+    # giants: long enough that the full-footprint reservation dwarfs the
+    # pool while staying admissible (prompt + output <= max_total)
+    long_tokens = max(
+        workload.prompt_tokens_max,
+        (workload.max_total_tokens or 4 * workload.prompt_tokens_max)
+        - 2 * workload.output_tokens_max,
+    )
+    # giants are a BURST, not the population: ~3% of arrivals, so the
+    # p95 statistic sits on the shorts the giants disrupt. Chunking
+    # deliberately trades the giant's own TTFT (it ingests over several
+    # steps instead of one long stall) for everyone else's — at a high
+    # giant fraction p95 lands on the giants themselves and measures
+    # the cost side of that trade, not the benefit. The longer decode
+    # tail (median 16) keeps seats and pool genuinely occupied, so a
+    # giant's arrival actually collides with live work
+    giant_frac = 0.03
+    burst_out_median = 16
+    burst_workload = _dc_replace(
+        workload, long_prompt_fraction=giant_frac,
+        long_prompt_tokens=long_tokens,
+        output_tokens_min=burst_out_median // 2,
+        output_tokens_median=burst_out_median,
+    )
+    # pool sized to ONE giant's full footprint plus four seats of median
+    # shorts: the OFF arm's FIFO head can only fund a giant after the
+    # pool drains to almost nothing — and every short behind the giant
+    # waits out that drain with it. The ON arm admits the giant on its
+    # first chunk's blocks and grows per chunk
+    giant_fp = (
+        (long_tokens + workload.output_tokens_max + block_size - 1)
+        // block_size
+    )
+    short_fp = (
+        (workload.prompt_tokens_median + burst_out_median
+         + block_size - 1) // block_size
+    )
+    ab_blocks = 1 + giant_fp + 4 * short_fp
+    # budget: a giant ingests in ~4 chunks — small enough that chunking
+    # is real, large enough that SRPT leftovers still drain giants
+    chunk_budget = max(4 * block_size, _next_pow2(long_tokens // 4))
+    # the per-step base cost relative to one budget-sized chunk of
+    # prefill: a decode step computes max_slots token positions vs the
+    # chunk's ``chunk_budget``, so it is a small fraction of a chunk —
+    # pricing it at a FULL quantum would bill the ON arm one phantom
+    # quantum per chunk step and bury the stall signal under it
+    step_base = 0.25
+    # rates come from the WORK-WEIGHTED capacity, not the seat count:
+    # under _work_cost a request consumes a prefill-step base + its
+    # prompt's bucket tokens / budget + its full-batch share of the
+    # decode steps. Offering the flat-clock seat capacity here would
+    # put BOTH arms in runaway overload and measure nothing but queue
+    # explosion
+    avg_prompt = (
+        (1.0 - giant_frac) * workload.prompt_tokens_median
+        + giant_frac * long_tokens
+    )
+    chunk_quanta = (
+        step_base + avg_prompt / chunk_budget
+        + step_base * burst_out_median / max_slots
+    )
+    vcap_chunk = 1.0 / (chunk_quanta * ab_dt)
+    burst_phases = (
+        Phase("warmup", "warmup", 1.0, 0.3 * vcap_chunk),
+        Phase("soak", "soak", 3.5, 0.8 * vcap_chunk),
+    )
+    prime_lens = sorted({
+        4, workload.prompt_tokens_median, workload.prompt_tokens_max,
+        chunk_budget, long_tokens,
+    })
+    def _work_cost(eng):
+        """Work-weighted virtual step cost, identical for both arms: a
+        base quantum of decode/dispatch plus one quantum per
+        ``chunk_budget`` of padded prefill tokens the step issued. This
+        is the physics chunking trades in — a giant's one-shot prefill
+        is one LONG step that stalls every seated request, a chunk is a
+        short one — and a flat-quantum clock (which prices a 256-token
+        prefill the same as a decode) erases it."""
+        last = [eng.prefill_bucket_tokens_total]
+        def cost(_):
+            cur = eng.prefill_bucket_tokens_total
+            d, last[0] = cur - last[0], cur
+            return ab_dt * (step_base + d / chunk_budget)
+        return cost
+
+    eng_off, clk_off = _arm_engine(num_blocks=ab_blocks)
+    _prime(eng_off, prime_lens)
+    rep_off = _arm_report("chunked-off", eng_off, clk_off, burst_workload,
+                          burst_phases, step_cost=_work_cost(eng_off))
+    eng_on, clk_on = _arm_engine(
+        num_blocks=ab_blocks, prefill_chunk_tokens=chunk_budget,
+        preemption=True,
+    )
+    _prime(eng_on, prime_lens)
+    rep_on = _arm_report("chunked-on", eng_on, clk_on, burst_workload,
+                         burst_phases, step_cost=_work_cost(eng_on))
+    ttft_off, ttft_on = _soak_p95(rep_off), _soak_p95(rep_on)
+
+    # preemption A/B: past-capacity arrivals while pool_pressure pins
+    # half the free blocks — the shed-only arm ages its queue past the
+    # deadline, the preemption arm pauses seated work instead. The pool
+    # is sized off the MEDIAN footprint so it (not the seat count) is
+    # the binding resource: ~3 median requests in flight fill it, yet
+    # the largest single request still fits
+    median_fp = (
+        (workload.prompt_tokens_median + workload.output_tokens_median
+         + block_size - 1) // block_size
+    )
+    max_fp = (
+        (workload.prompt_tokens_max + workload.output_tokens_max
+         + block_size - 1) // block_size
+    )
+    pressure_blocks = 1 + max(3 * median_fp, max_fp + 1)
+    pressure_phases = (
+        Phase("warmup", "warmup", 1.0, 0.35 * vcap),
+        Phase("fault", "fault", 2.0, 1.3 * vcap),
+        Phase("recovery", "recovery", 1.0, 0.35 * vcap),
+    )
+    pressure_fault = "pool_pressure@0:secs=1.2"
+    delay = 0.3  # 30 virtual steps of queue patience
+    eng_shed, clk_shed = _arm_engine(
+        num_blocks=pressure_blocks, max_queue_delay_s=delay,
+    )
+    _prime(eng_shed, prime_lens[:-1])
+    rep_shed = _arm_report("preempt-off", eng_shed, clk_shed, workload,
+                           pressure_phases, fault=pressure_fault)
+    eng_pre, clk_pre = _arm_engine(
+        num_blocks=pressure_blocks, max_queue_delay_s=delay,
+        preemption=True,
+    )
+    _prime(eng_pre, prime_lens[:-1])
+    rep_pre = _arm_report("preempt-on", eng_pre, clk_pre, workload,
+                          pressure_phases, fault=pressure_fault)
+    # every request preempted+resumed under chaos must finish with the
+    # same tokens the uncontended (shed-only) arm produced for it —
+    # requests the control shed have no reference and are skipped
+    preempted_ids = [
+        r["request_id"] for r in eng_pre.stats.requests
+        if r.get("preempted_count")
+    ]
+    preempt_outputs_match = all(
+        eng_pre.result(rid) == eng_shed.result(rid)
+        for rid in preempted_ids if eng_shed.result(rid) is not None
+    )
+
+    tel_fp, tel_i8 = StepTelemetry(True), StepTelemetry(True)
+    eng_fp = ServingEngine(
+        model, params, max_slots=max_slots, block_size=block_size,
+        telemetry=tel_fp,
+    )
+    eng_i8 = ServingEngine(
+        model, params, max_slots=max_slots, block_size=block_size,
+        telemetry=tel_i8, kv_dtype="int8",
+    )
+    kv_fp = (tel_fp.sample_memory(force=True) or {}).get(
+        "census_owner_bytes", {}
+    ).get("kv_cache", 0)
+    kv_i8 = (tel_i8.sample_memory(force=True) or {}).get(
+        "census_owner_bytes", {}
+    ).get("kv_cache", 0)
+    kv_ratio = kv_fp / kv_i8 if kv_i8 else None
+    # fixed-HBM-budget seat arithmetic from the CENSUS bytes: the fp
+    # pool's measured footprint, spent on int8-priced blocks, funds
+    # this many concurrent median-shaped requests instead
+    pool_blocks = eng_fp.pool.num_blocks
+    footprint = eng_fp.pool.blocks_for_tokens(
+        workload.prompt_tokens_median + workload.output_tokens_median
+    )
+    seats_fp = (pool_blocks - 1) // footprint
+    i8_blocks = int(kv_fp // (kv_i8 / pool_blocks)) if kv_i8 else 0
+    seats_i8 = max(0, i8_blocks - 1) // footprint
+    seat_ratio = seats_i8 / seats_fp if seats_fp else None
+
+    def _drain_outputs(eng):
+        ids = [
+            eng.add_request(list(r.prompt),
+                            max_new_tokens=r.max_new_tokens)
+            for r in calib
+        ]
+        while eng.has_work:
+            eng.step()
+        return [eng.result(rid) for rid in ids]
+
+    int8_match = _drain_outputs(eng_fp) == _drain_outputs(eng_i8)
+    ab_wall_s = time.perf_counter() - t_soak - soak_wall_s
+
     head = report["headline"]
     fault = report["fault"]
     return {
@@ -1291,6 +1564,50 @@ def _run_serve_soak(cfg, max_slots: int, block_size: int,
             "recovered": fault["recovered"],
             "decode_retraces_after_warmup": report["decode_retraces"],
             "engine_steps": report["engine_steps"],
+            # chunked prefill A/B: soak p95 TTFT on the long-prompt-
+            # burst trace (acceptance: ON strictly better, 0 retraces)
+            "chunked_budget_tokens": chunk_budget,
+            "chunked_soak_p95_ttft_off_s": (
+                round(ttft_off, 5) if ttft_off is not None else None
+            ),
+            "chunked_soak_p95_ttft_on_s": (
+                round(ttft_on, 5) if ttft_on is not None else None
+            ),
+            "chunked_ttft_improvement": (
+                round(ttft_off / ttft_on, 3)
+                if ttft_off and ttft_on else None
+            ),
+            "chunked_decode_retraces": (
+                rep_off["decode_retraces"] + rep_on["decode_retraces"]
+            ),
+            "chunked_prefill_chunks_total": eng_on._prefill_chunks_total,
+            # preemption A/B under pool_pressure chaos (acceptance: ON
+            # sheds strictly fewer in the fault window; resumed outputs
+            # bitwise-match the shed-only control)
+            "preempt_fault_sheds_off": (
+                rep_shed["fault"]["sheds_in_window"]
+            ),
+            "preempt_fault_sheds_on": rep_pre["fault"]["sheds_in_window"],
+            "preempt_fault_preempts_on": (
+                rep_pre["fault"]["preempts_in_window"]
+            ),
+            "preempt_resumes_total": eng_pre._resumes_total,
+            "preempt_requests_resumed_finished": len(preempted_ids),
+            "preempt_outputs_match": preempt_outputs_match,
+            # int8 KV: census-verified kv_cache owner bytes + the
+            # fixed-budget seat arithmetic (acceptance: >= 1.8x)
+            "int8_kv_bytes_census_fp": int(kv_fp),
+            "int8_kv_bytes_census_int8": int(kv_i8),
+            "int8_kv_bytes_ratio": (
+                round(kv_ratio, 3) if kv_ratio else None
+            ),
+            "int8_concurrent_requests_fp": seats_fp,
+            "int8_concurrent_requests_int8": seats_i8,
+            "int8_capacity_ratio": (
+                round(seat_ratio, 3) if seat_ratio else None
+            ),
+            "int8_greedy_outputs_match": int8_match,
+            "ab_wall_s": round(ab_wall_s, 3),
             "soak_wall_s": round(soak_wall_s, 3),
             "calib_wall_s": round(calib_s, 3),
             "unit_s": round(unit_s, 3),

@@ -167,10 +167,13 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
             # mid-soak). Rates self-calibrate from a closed-loop probe,
             # so the ~10-25s program cost is host-independent; NOT fast
             # because the wall-clock phases cannot be shrunk below the
-            # SLO windows. args: (cfg, max_slots, block_size,
+            # SLO windows. After the main program, six short A/B arms
+            # (chunked prefill, preemption-vs-shed under pool_pressure,
+            # fp-vs-int8 KV) each pay a fresh engine compile — the
+            # estimate covers them. args: (cfg, max_slots, block_size,
             # target_requests, seed)
             _variant("serve_soak", "serve_soak", 4, "serve",
-                     (tiny, 4, 8, 96, 0), default_estimate_s=120),
+                     (tiny, 4, 8, 96, 0), default_estimate_s=240),
             _variant("ckpt", "ckpt", 3, "ckpt", (tiny, 4, 64, 8, 2),
                      fast=True, default_estimate_s=15),
             # adapter-only vs full fine-tune economics + the multi-tenant
@@ -303,9 +306,11 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
         _variant("serve", "serve", 3, "decode", (decode, 4, 16, 8, 0),
                  default_estimate_s=2000),
         # soak & chaos on the ~5.5B decode model (same child process /
-        # resident compile budget); args mirror serve's
+        # resident compile budget); args mirror serve's. The capacity
+        # A/B arms (chunked/preempt/int8) add six engine compiles at
+        # this size — the estimate covers them.
         _variant("serve_soak", "serve_soak", 4, "decode",
-                 (decode, 4, 16, 64, 0), default_estimate_s=900),
+                 (decode, 4, 16, 64, 0), default_estimate_s=1200),
         _variant("moe", "train", 3, "moe", (moe, 16, 1024, 20, 3),
                  default_estimate_s=600),
         _variant("longseq", "train", 3, "longseq", (longseq, 1, 8192, 8, 2),

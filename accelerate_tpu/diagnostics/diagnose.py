@@ -219,6 +219,11 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
                 "admission_blocked_no_free_slot_total",
                 "admission_blocked_pool_exhausted_total",
                 "shed_queue_full_total", "shed_queue_deadline_total",
+                "swapped_blocks", "swapped_requests", "swap_bytes_held",
+                "preempts_total", "preempts_priority_total",
+                "preempts_pool_total", "preempts_growth_total",
+                "resumes_total", "prefill_chunks_total",
+                "kv_bytes_per_token",
             ):
                 entry[key] = gauge.get(key)
         if slo is not None:
@@ -450,6 +455,22 @@ def format_report(report: dict) -> str:
                     f"cow_copies={s.get('cow_copies_total') or 0} "
                     f"prefill_tokens_saved={saved or 0}"
                 )
+            if s.get("preempts_total") or s.get("prefill_chunks_total"):
+                kvb = s.get("kv_bytes_per_token")
+                lines.append(
+                    f"    capacity: preempts={s.get('preempts_total') or 0} "
+                    f"(priority={s.get('preempts_priority_total') or 0} "
+                    f"pool={s.get('preempts_pool_total') or 0} "
+                    f"growth={s.get('preempts_growth_total') or 0}) "
+                    f"resumes={s.get('resumes_total') or 0} "
+                    f"swapped_blocks={s.get('swapped_blocks') or 0} "
+                    f"swap_bytes={s.get('swap_bytes_held') or 0} "
+                    f"prefill_chunks={s.get('prefill_chunks_total') or 0}"
+                    + (
+                        f" kv_bytes/token={kvb:.0f}"
+                        if kvb is not None else ""
+                    )
+                )
             if s.get("spec_tokens_proposed"):
                 lines.append(
                     f"    speculation: "
@@ -525,6 +546,11 @@ def format_report(report: dict) -> str:
                 "  fault: " + ", ".join(fault["specs"])
                 + f"  damage: sheds={fault.get('sheds_in_window') or 0}"
                 f" slo_violations={fault.get('slo_violations_in_window') or 0}"
+                + (
+                    f" preempts={fault.get('preempts_in_window')}"
+                    if fault.get("preempts_in_window") is not None
+                    else ""
+                )
                 + (
                     f"  recovered in {rec_s:.2f}s"
                     if rec_s is not None

@@ -65,6 +65,14 @@ class SoakConfig:
     """One soak run: workload x phase program x clocking x chaos.
 
     ``step_dt_s``: virtual seconds per engine step (None = wall clock).
+    ``step_cost``: virtual mode only — a callable taking the engine and
+    returning THIS step's virtual duration, consulted after each engine
+    step instead of the flat ``step_dt_s`` quantum. Use it to charge
+    steps by the work they actually issued (e.g. a delta of the
+    engine's ``prefill_bucket_tokens_total``), so compute serialization
+    — a giant prefill stalling the whole batch for one long step — is
+    visible on hosts whose wall clock is all dispatch overhead. Idle
+    gaps still advance at the flat quantum.
     ``fault_specs``: ``ACCELERATE_TPU_FAULT_INJECT``-grammar string with
     steps relative to the fault-window entry step; empty string reads
     the env var (and stays inert if that is unset too).
@@ -80,6 +88,7 @@ class SoakConfig:
     phases: tuple = dataclasses.field(default_factory=standard_program)
     seed: int = 0
     step_dt_s: Optional[float] = 0.01
+    step_cost: Optional[Callable] = None
     slo: object = None
     gauge_interval: int = 4
     fault_specs: str = ""
@@ -141,6 +150,8 @@ class SoakHarness:
         self._recovered_after_s: Optional[float] = None
         self._fault_sheds = 0
         self._fault_violations = 0
+        self._fault_preempts = 0
+        self._preempts_total = 0
         self.slo_tracker = None
         self.chaos: Optional[ChaosAdapter] = None
 
@@ -217,7 +228,11 @@ class SoakHarness:
                         continue  # the fault fired on THIS step
                     self.engine.step()
                     if cfg.step_dt_s is not None:
-                        self.clock.advance(cfg.step_dt_s)
+                        self.clock.advance(
+                            cfg.step_cost(self.engine)
+                            if cfg.step_cost is not None
+                            else cfg.step_dt_s
+                        )
                     self._poll_recovery()
                 else:
                     self._advance_idle(rel, trace, next_i, total_s)
@@ -389,6 +404,12 @@ class SoakHarness:
             if self._in_fault_window(rel):
                 self._fault_violations += 1
 
+    def _on_preempt(self, fields: dict) -> None:
+        rel = self.clock() - self._t0
+        self._preempts_total += 1
+        if self._in_fault_window(rel):
+            self._fault_preempts += 1
+
     def _on_shed(self, fields: dict) -> None:
         rel = self.clock() - self._t0
         acc = self._accs[min(self._cur, len(self._accs) - 1)]
@@ -555,6 +576,11 @@ class SoakHarness:
             "events": list(self.chaos.events) if self.chaos else [],
             "sheds_in_window": self._fault_sheds,
             "slo_violations_in_window": self._fault_violations,
+            # preemption turns would-be sheds into pauses: the soak's
+            # acceptance check compares sheds_in_window against a
+            # shed-only baseline and expects strictly fewer here
+            "preempts_in_window": self._fault_preempts,
+            "preempts_total": self._preempts_total,
             "recovery_s": (
                 round(self._recovered_after_s, 6)
                 if self._recovered_after_s is not None else None
@@ -634,6 +660,13 @@ class _TelemetryTee:
         self._harness._on_slo(fields)
         if self._inner is not None:
             fn = getattr(self._inner, "record_slo", None)
+            if fn is not None:
+                fn(**fields)
+
+    def record_preempt(self, **fields):
+        self._harness._on_preempt(fields)
+        if self._inner is not None:
+            fn = getattr(self._inner, "record_preempt", None)
             if fn is not None:
                 fn(**fields)
 

@@ -60,6 +60,13 @@ class WorkloadConfig:
     tail_alpha: float = 2.0           # Pareto tail index (smaller = fatter)
     adapters: tuple = ()              # tenant names to mix in
     adapter_fraction: float = 0.0     # share of requests naming a tenant
+    # long-prompt burst (PR 17): this share of requests carries a GIANT
+    # body of ``long_prompt_tokens`` (default: prompt_tokens_max) drawn
+    # deterministically instead of from the Pareto tail — the traffic
+    # that makes unchunked prefill hold every short request's TTFT
+    # hostage, and the A/B axis the chunked-prefill soak runs on
+    long_prompt_fraction: float = 0.0
+    long_prompt_tokens: Optional[int] = None
     max_total_tokens: Optional[int] = None
 
     def __post_init__(self):
@@ -67,9 +74,17 @@ class WorkloadConfig:
             raise ValueError("vocab_size must be >= 2")
         if self.num_cohorts < 0 or self.prefix_tokens < 0:
             raise ValueError("num_cohorts/prefix_tokens must be >= 0")
-        for frac in (self.cohort_fraction, self.adapter_fraction):
+        for frac in (self.cohort_fraction, self.adapter_fraction,
+                     self.long_prompt_fraction):
             if not (0.0 <= frac <= 1.0):
                 raise ValueError("fractions must be in [0, 1]")
+        if (
+            self.long_prompt_tokens is not None
+            and self.long_prompt_tokens < self.prompt_tokens_min
+        ):
+            raise ValueError(
+                "long_prompt_tokens must be >= prompt_tokens_min"
+            )
         if self.adapter_fraction > 0 and not self.adapters:
             raise ValueError("adapter_fraction > 0 needs adapter names")
         if self.prompt_tokens_min < 1 or self.output_tokens_min < 1:
@@ -144,10 +159,22 @@ def _draw_request(rng, workload, cohorts, index, arrival_s, phase):
     if cohorts and float(rng.random()) < workload.cohort_fraction:
         cohort = int(rng.integers(len(cohorts)))
         prefix = cohorts[cohort]
-    body_len = _tail_len(
-        rng, workload.prompt_tokens_min, workload.prompt_tokens_median,
-        workload.prompt_tokens_max, workload.tail_alpha,
-    )
+    # burst giants draw their coin only when the knob is on, so traces
+    # generated before the knob existed replay bit-identically
+    if (
+        workload.long_prompt_fraction > 0.0
+        and float(rng.random()) < workload.long_prompt_fraction
+    ):
+        body_len = (
+            workload.long_prompt_tokens
+            if workload.long_prompt_tokens is not None
+            else workload.prompt_tokens_max
+        )
+    else:
+        body_len = _tail_len(
+            rng, workload.prompt_tokens_min, workload.prompt_tokens_median,
+            workload.prompt_tokens_max, workload.tail_alpha,
+        )
     body = tuple(int(t) for t in rng.integers(1, workload.vocab_size, body_len))
     max_new = _tail_len(
         rng, workload.output_tokens_min, workload.output_tokens_median,

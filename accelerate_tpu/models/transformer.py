@@ -351,21 +351,42 @@ class Attention(nn.Module):
             # the per-call block tables in ``paged`` (ops/attention.py's
             # PagedKVState). The has_variable guard keeps the init pass on
             # the plain path (creation must not write).
+            # int8 paged KV: pools store sym-quantized rows, one fp32
+            # amax scale per token slot beside them ((num_blocks,
+            # block_size) — ~4 bytes/token overhead vs the 2x row
+            # shrink). kv_dtype is static PagedKVState metadata, so the
+            # branch resolves at trace time: one engine, one lattice.
+            kv_int8 = getattr(paged, "kv_dtype", "native") == "int8"
+            pool_dtype = jnp.int8 if kv_int8 else k.dtype
             is_initialized = self.has_variable("cache", "key_pool")
             key_pool = self.variable(
                 "cache", "key_pool",
                 lambda: jnp.zeros(
                     (paged.num_blocks, paged.block_size,
-                     cfg.num_kv_heads, cfg.head_dim), k.dtype,
+                     cfg.num_kv_heads, cfg.head_dim), pool_dtype,
                 ),
             )
             value_pool = self.variable(
                 "cache", "value_pool",
                 lambda: jnp.zeros(
                     (paged.num_blocks, paged.block_size,
-                     cfg.num_kv_heads, cfg.head_dim), v.dtype,
+                     cfg.num_kv_heads, cfg.head_dim), pool_dtype,
                 ),
             )
+            key_scale = value_scale = None
+            if kv_int8:
+                key_scale = self.variable(
+                    "cache", "key_scale",
+                    lambda: jnp.zeros(
+                        (paged.num_blocks, paged.block_size), jnp.float32
+                    ),
+                )
+                value_scale = self.variable(
+                    "cache", "value_scale",
+                    lambda: jnp.zeros(
+                        (paged.num_blocks, paged.block_size), jnp.float32
+                    ),
+                )
             use_paged = is_initialized
             decode = False
         elif decode:
@@ -396,14 +417,25 @@ class Attention(nn.Module):
             positions = paged.cache_len[:, None] + jnp.arange(s)[None, :]
             q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
             k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-            new_k, new_v = paged_update(
-                key_pool.value, value_pool.value, k, v, paged
-            )
+            new_ks = new_vs = None
+            if kv_int8:
+                new_k, new_v, new_ks, new_vs = paged_update(
+                    key_pool.value, value_pool.value, k, v, paged,
+                    key_scale=key_scale.value,
+                    value_scale=value_scale.value,
+                )
+                key_scale.value = new_ks
+                value_scale.value = new_vs
+            else:
+                new_k, new_v = paged_update(
+                    key_pool.value, value_pool.value, k, v, paged
+                )
             key_pool.value = new_k
             value_pool.value = new_v
             out = paged_attention(
                 q, new_k, new_v, paged, scale=scale,
                 softcap=cfg.attn_softcap, window=window,
+                key_scale=new_ks, value_scale=new_vs,
             )
         elif decode:
             idx = cache_index.value

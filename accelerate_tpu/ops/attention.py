@@ -49,6 +49,14 @@ class PagedKVState:
 
     ``num_blocks`` / ``block_size`` are static (pytree metadata): one
     engine → one compiled program shape.
+
+    ``kv_dtype`` selects the pool storage format, also static (it picks
+    the compiled program's dtype lattice): ``"native"`` stores K/V at
+    the model's compute dtype; ``"int8"`` stores sym-quantized int8
+    rows with one fp32 amax scale per written token slot — decode is
+    HBM-bandwidth-bound, so the 2x (vs bf16) byte shrink is a direct
+    capacity/throughput lever (:func:`paged_update` quantizes on
+    write, :func:`paged_attention` dequantizes on gather).
     """
 
     block_table: jax.Array
@@ -56,6 +64,32 @@ class PagedKVState:
     lengths: jax.Array
     num_blocks: int = flax.struct.field(pytree_node=False)
     block_size: int = flax.struct.field(pytree_node=False)
+    kv_dtype: str = flax.struct.field(pytree_node=False, default="native")
+
+
+# floor on the per-token amax scale: keeps all-zero rows (garbage block,
+# never-written slots) dividing to exact 0 instead of NaN
+KV_SCALE_EPS = 1e-8
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-token int8 quantization of a K or V tensor.
+
+    ``x``: (B, S, Hkv, D) -> int8 values of the same shape + (B, S)
+    fp32 scales, one amax scale per token row (over all kv heads and
+    head dims). Per-TOKEN (not per-whole-block) scales are what make
+    incremental decode writes exact-cost: appending token t to a
+    half-full block touches only slot t's row and scale — a true
+    per-block amax would need requantizing every earlier row whenever
+    the running amax grew. The scale arrays live beside the pools at
+    (num_blocks, block_size), i.e. one fp32 per pool row: the
+    "per-block scales stored beside the pool" layout at 4 bytes per
+    token of overhead against ~2*Hkv*D quantized bytes saved.
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(2, 3))
+    scale = jnp.maximum(amax / 127.0, KV_SCALE_EPS)
+    q = jnp.round(x.astype(jnp.float32) / scale[:, :, None, None])
+    return jnp.clip(q, -127.0, 127.0).astype(jnp.int8), scale
 
 
 def paged_update(
@@ -64,7 +98,9 @@ def paged_update(
     k: jax.Array,
     v: jax.Array,
     state: PagedKVState,
-) -> tuple[jax.Array, jax.Array]:
+    key_scale: Optional[jax.Array] = None,
+    value_scale: Optional[jax.Array] = None,
+) -> tuple[jax.Array, ...]:
     """Scatter one call's K/V into the block pools.
 
     ``k``/``v``: (B, S, Hkv, D); token i of slot b belongs at global
@@ -80,6 +116,11 @@ def paged_update(
     passes the cached-token count as ``cache_len`` and only the uncached
     tail as ``k``/``v`` — the shared prefix blocks in ``block_table`` are
     read by attention but never written.
+
+    With ``state.kv_dtype == "int8"`` the per-token amax scale arrays
+    (``key_scale``/``value_scale``, (num_blocks, block_size) fp32) must
+    ride along: K/V rows are quantized on the way in and the return
+    grows to ``(key_pool, value_pool, key_scale, value_scale)``.
     """
     b, s = k.shape[:2]
     bs = state.block_size
@@ -91,6 +132,21 @@ def paged_update(
     blocks = jnp.where(valid, blocks, 0)
     offsets = pos % bs
     bf, of = blocks.reshape(-1), offsets.reshape(-1)
+    if state.kv_dtype == "int8":
+        if key_scale is None or value_scale is None:
+            raise ValueError(
+                "kv_dtype='int8' needs the key_scale/value_scale arrays"
+            )
+        k, k_s = quantize_kv(k)
+        v, v_s = quantize_kv(v)
+        kf = k.reshape(b * s, *k.shape[2:])
+        vf = v.reshape(b * s, *v.shape[2:])
+        return (
+            key_pool.at[bf, of].set(kf),
+            value_pool.at[bf, of].set(vf),
+            key_scale.at[bf, of].set(k_s.reshape(-1)),
+            value_scale.at[bf, of].set(v_s.reshape(-1)),
+        )
     kf = k.reshape(b * s, *k.shape[2:])
     vf = v.reshape(b * s, *v.shape[2:])
     return key_pool.at[bf, of].set(kf), value_pool.at[bf, of].set(vf)
@@ -104,6 +160,8 @@ def paged_attention(
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
     window=None,
+    key_scale: Optional[jax.Array] = None,
+    value_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention read through the block table: gather each slot's blocks
     into a (B, max_blocks*block_size, Hkv, D) view and run the xla path
@@ -114,6 +172,10 @@ def paged_attention(
     sliding band). Table tail entries point at the garbage block, whose
     columns sit beyond every row and mask out. One compiled program for
     prefill (B=1, S=bucket) and decode (B=slots, S=1) alike.
+
+    Under ``kv_dtype="int8"`` the gathered int8 rows are dequantized
+    (row * its per-token scale) at the query's dtype before the math —
+    the pools stay int8 in HBM, only the gathered working set widens.
     """
     b, s = q.shape[:2]
     bs = state.block_size
@@ -124,6 +186,15 @@ def paged_attention(
     v = value_pool[state.block_table].reshape(
         b, max_blocks * bs, *value_pool.shape[2:]
     )
+    if state.kv_dtype == "int8":
+        if key_scale is None or value_scale is None:
+            raise ValueError(
+                "kv_dtype='int8' needs the key_scale/value_scale arrays"
+            )
+        k_s = key_scale[state.block_table].reshape(b, max_blocks * bs)
+        v_s = value_scale[state.block_table].reshape(b, max_blocks * bs)
+        k = (k.astype(jnp.float32) * k_s[:, :, None, None]).astype(q.dtype)
+        v = (v.astype(jnp.float32) * v_s[:, :, None, None]).astype(q.dtype)
     rows = (state.cache_len[:, None] + jnp.arange(s)[None, :])[:, None, :, None]
     cols = jnp.arange(max_blocks * bs)[None, None, None, :]
     keep = cols <= rows  # (B, 1, S, K)

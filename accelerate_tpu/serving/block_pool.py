@@ -59,6 +59,18 @@ class BlockPool:
     * CACHED     — refcount 0 but content-indexed: parked in the LRU,
                    reusable via :meth:`lookup`/:meth:`acquire`, evicted
                    (index entry dropped) under allocation pressure.
+
+    Preemption (PR 17) adds a fourth, LOGICAL state: SWAPPED. A
+    preempted request's block CONTENTS move to host RAM (the engine
+    does the device_get; the pool only keeps the ledger) and the device
+    block ids return to circulation — so the device-side invariant
+    stays ``num_free + num_allocated + num_cached == num_blocks - 1``,
+    while ``num_swapped`` counts host-resident block images awaiting
+    :meth:`swap_in`. The extended conservation law the fuzz test pins:
+    device states partition the allocatable ids at all times, AND every
+    ``swap_out`` increments the swapped ledger by exactly the block
+    images it released, every ``swap_in``/:meth:`swap_drop` decrements
+    it, and the ledger can never go negative.
     """
 
     def __init__(self, num_blocks: int, block_size: int):
@@ -79,6 +91,11 @@ class BlockPool:
         # first — popitem(last=False) is the eviction end)
         self._lru: "OrderedDict[int, None]" = OrderedDict()
         self.evictions_total = 0
+        # preemption ledger: host-resident block images (contents saved
+        # by the engine's swap-out) whose device ids were released
+        self._swapped = 0
+        self.swap_outs_total = 0
+        self.swap_ins_total = 0
 
     # ------------------------------------------------------------------ #
     # occupancy
@@ -101,6 +118,13 @@ class BlockPool:
     def num_shared(self) -> int:
         """Allocated blocks currently held by >= 2 requests."""
         return sum(1 for n in self._ref.values() if n >= 2)
+
+    @property
+    def num_swapped(self) -> int:
+        """Host-resident block images of preempted requests — logical
+        footprint awaiting :meth:`swap_in`, NOT device occupancy (their
+        device ids were recycled at swap-out)."""
+        return self._swapped
 
     def refcount(self, block: int) -> int:
         return self._ref.get(block, 0)
@@ -193,6 +217,65 @@ class BlockPool:
             raise
 
     # ------------------------------------------------------------------ #
+    # preemption swap ledger
+    # ------------------------------------------------------------------ #
+    def swap_out(self, blocks: Iterable[int]) -> None:
+        """Release a preempted request's references after the engine
+        saved the block contents to host RAM, and grow the swapped
+        ledger by one image per block.
+
+        Per block: a SHARED block (refcount >= 2) just drops this
+        holder's reference — the other holders keep it device-resident
+        (the saved host image guarantees bitwise resume even if they
+        finish and the cached chain is later evicted). A private
+        refcount-1 block is unpublished (its saved content is leaving
+        the device, so the index entry would go stale) and returned to
+        the free list. Raises on blocks that are not allocated — a
+        swap-out of foreign/freed blocks would corrupt the ledger."""
+        n = 0
+        for b in blocks:
+            if b not in self._ref:
+                raise ValueError(
+                    f"swapping out block {b} that is not allocated"
+                )
+            if self._ref[b] == 1 and b in self._hash_of:
+                key = self._hash_of.pop(b)
+                if self._index.get(key) == b:
+                    del self._index[key]
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                self._free.append(b)
+            n += 1
+        self._swapped += n
+        if n:
+            self.swap_outs_total += 1
+
+    def swap_in(self, n: int) -> list[int]:
+        """Re-fund ``n`` swapped images with fresh device blocks
+        (refcount 1, contents garbage until the engine's scatter
+        restores them) and shrink the ledger. Gate on
+        :meth:`can_allocate` like any allocation."""
+        if n < 0 or n > self._swapped:
+            raise ValueError(
+                f"swap_in({n}) but only {self._swapped} images swapped out"
+            )
+        blocks = self.allocate(n)
+        self._swapped -= n
+        if n:
+            self.swap_ins_total += 1
+        return blocks
+
+    def swap_drop(self, n: int) -> None:
+        """Forget ``n`` swapped images without restoring them (the
+        preempted request was cancelled/shed while on the host)."""
+        if n < 0 or n > self._swapped:
+            raise ValueError(
+                f"swap_drop({n}) but only {self._swapped} images swapped out"
+            )
+        self._swapped -= n
+
+    # ------------------------------------------------------------------ #
     # content index
     # ------------------------------------------------------------------ #
     def publish(self, block: int, key: bytes) -> int:
@@ -254,6 +337,9 @@ class BlockPool:
             "allocated": len(self._ref),
             "cached": len(self._lru),
             "shared": self.num_shared,
+            "swapped": self._swapped,
+            "swap_outs_total": self.swap_outs_total,
+            "swap_ins_total": self.swap_ins_total,
             "evictions_total": self.evictions_total,
             "utilization": len(self._ref) / usable if usable else 0.0,
         }

@@ -65,6 +65,30 @@ def _next_pow2(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
 
 
+@dataclass
+class _SwappedRequest:
+    """A preempted request parked on the host: everything needed to
+    re-seat it bitwise-identically — the slot's scheduler state plus its
+    blocks' gathered CONTENTS (``data``: one host array per paged-cache
+    leaf, leading axis = block position in the slot's table order).
+    The device block ids were recycled at swap-out; only the images and
+    the ledger entry (``BlockPool.num_swapped``) remain."""
+
+    request: Request
+    generated: list[int]
+    pending: int
+    cache_len: int
+    n_blocks: int
+    data: list
+    chunks: int
+    preempted_count: int
+    admit_time: float
+    first_token_time: float
+    cached_tokens: int
+    swap_bytes: int
+    preempt_time: float
+
+
 class ServingEngine:
     """Continuous-batching serving over a paged KV cache.
 
@@ -125,11 +149,39 @@ class ServingEngine:
         prefix_cache: bool = False,
         model_fingerprint: Optional[str] = None,
         spec_decode: Optional[SpecConfig] = None,
+        prefill_chunk_tokens: Optional[int] = None,
+        preemption: bool = False,
+        kv_dtype: str = "bf16",
     ):
         self.model = model
         self.params = params
         self.max_slots = max_slots
         self.block_size = block_size
+        # --- PR 17 capacity levers (all default OFF) ---------------- #
+        # chunked prefill: per-STEP prompt-token budget. Prompt
+        # ingestion splits into <= budget chunks interleaved with
+        # decode steps (same pow2-bucket prefill programs, cache_len
+        # carries the true offset), so a long prompt stops head-of-
+        # line-blocking the decode batch and short prompts clear first
+        # (shortest-remaining-first within the budget).
+        if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
+            raise ValueError("prefill_chunk_tokens must be >= 1 (or None)")
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        # preemption with KV swap: under pool pressure a victim slot's
+        # block CONTENTS device_get to a host swap area, its blocks
+        # free, and the request resumes later by restoring the images
+        # into fresh blocks at true cache offsets (sheds become pauses).
+        self.preemption = preemption
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16' (native) or 'int8', got {kv_dtype!r}"
+            )
+        # int8 paged KV: pools store sym-quantized rows + per-token
+        # scales ((num_blocks, block_size) fp32 beside each pool);
+        # "bf16" keeps the pools at the model's native compute dtype.
+        self.kv_dtype = kv_dtype
+        kv_state_dtype = "int8" if kv_dtype == "int8" else "native"
+        self._kv_state_dtype = kv_state_dtype
         # multi-tenant serving: an AdapterRegistry whose fixed-shape
         # stacks ride every prefill/decode call as traced data, indexed
         # by a per-slot adapter row (the per-slot-temperatures idiom).
@@ -164,6 +216,14 @@ class ServingEngine:
             prefix_cache=self.prefix_cache,
             max_table_blocks=self._max_table,
         )
+        # chunk-aware admission (the over-reservation fix) is only safe
+        # when preemption provides the can't-grow escape hatch: without
+        # it admission keeps the full-footprint reservation that makes
+        # mid-flight OOM impossible by construction.
+        self.scheduler.chunk_tokens = prefill_chunk_tokens
+        self.scheduler.chunked_reserve = (
+            prefill_chunk_tokens is not None and preemption
+        )
         self.sampling = SlotSampling(max_slots)
         self.stats = ServeStats()
         self.span_log = SpanLog(maxlen=span_history)
@@ -191,7 +251,10 @@ class ServingEngine:
         self._shed_order: collections.deque = collections.deque()
         self._steps = 0
         self._http: Any = None
-        self._traces = {"prefill": 0, "decode": 0, "cow": 0, "verify": 0}
+        self._traces = {
+            "prefill": 0, "decode": 0, "cow": 0, "verify": 0,
+            "swap_out": 0, "swap_in": 0,
+        }
         # every bucket width a prefill ever ran at — the set
         # capture_programs() reconstructs abstract specs from
         self._prefill_buckets: set[int] = set()
@@ -204,11 +267,37 @@ class ServingEngine:
             lengths=jnp.ones((1,), jnp.int32),
             num_blocks=num_blocks,
             block_size=block_size,
+            kv_dtype=kv_state_dtype,
         )
         self.cache = init_cache(
             model.init, jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
             decode=True, paged=init_state,
         )
+        # paged cache leaves by position: (flat leaf index, block axis)
+        # for every K/V pool ((..., num_blocks, block_size, Hkv, D)) and
+        # every int8 scale array ((..., num_blocks, block_size)) — the
+        # shared shape contract the COW copy and the preemption swap
+        # gather/scatter address blocks through
+        self._kv_leaf_info: list[tuple[int, int]] = []
+        kv_bytes = 0
+        for i, leaf in enumerate(jax.tree.leaves(self.cache)):
+            if (
+                leaf.ndim >= 4
+                and leaf.shape[-4] == num_blocks
+                and leaf.shape[-3] == block_size
+            ):
+                self._kv_leaf_info.append((i, leaf.ndim - 4))
+                kv_bytes += leaf.nbytes
+            elif (
+                leaf.ndim >= 2
+                and leaf.shape[-2] == num_blocks
+                and leaf.shape[-1] == block_size
+            ):
+                self._kv_leaf_info.append((i, leaf.ndim - 2))
+                kv_bytes += leaf.nbytes
+        # the sizing headline int8 halves: HBM bytes per cached token
+        # across every layer's pools (+ scale overhead when quantized)
+        self.kv_bytes_per_token = kv_bytes / (num_blocks * block_size)
 
         traces = self._traces
 
@@ -244,6 +333,7 @@ class ServingEngine:
                 lengths=length,
                 num_blocks=num_blocks,
                 block_size=block_size,
+                kv_dtype=kv_state_dtype,
             )
             logits, mutated = model.apply(
                 {"params": params, "cache": cache}, ids, decode=True,
@@ -265,6 +355,7 @@ class ServingEngine:
                 lengths=lengths,
                 num_blocks=num_blocks,
                 block_size=block_size,
+                kv_dtype=kv_state_dtype,
             )
             logits, mutated = model.apply(
                 {"params": params, "cache": cache}, tokens, decode=True,
@@ -300,6 +391,15 @@ class ServingEngine:
                 ):
                     lead = (slice(None),) * (leaf.ndim - 4)
                     return leaf.at[lead + (dst,)].set(leaf[lead + (src,)])
+                if (
+                    leaf.ndim >= 2
+                    and leaf.shape[-2] == num_blocks
+                    and leaf.shape[-1] == block_size
+                ):
+                    # int8 KV: the per-token scale rows travel with
+                    # their block's quantized contents
+                    lead = (slice(None),) * (leaf.ndim - 2)
+                    return leaf.at[lead + (dst,)].set(leaf[lead + (src,)])
                 return leaf
             return jax.tree.map(copy, cache)
 
@@ -322,6 +422,7 @@ class ServingEngine:
                     lengths=lengths,
                     num_blocks=num_blocks,
                     block_size=block_size,
+                    kv_dtype=kv_state_dtype,
                 )
                 logits, mutated = model.apply(
                     {"params": params, "cache": cache}, tokens, decode=True,
@@ -354,6 +455,24 @@ class ServingEngine:
         self._spec_proposed_total = 0
         self._spec_accepted_total = 0
         self._spec_rounds_total = 0
+        # preemption plane: compiled swap gather/scatter cached per pow2
+        # block-count width, host-parked requests (with their KV
+        # images), and the preempt/resume/chunk accounting the gauges
+        # export
+        self._swap_fns: dict[int, tuple] = {}
+        self._swapped_reqs: list[_SwappedRequest] = []
+        self._preempt_counts: dict[str, int] = {
+            "priority": 0, "pool": 0, "growth": 0,
+        }
+        self._resumes_total = 0
+        self._swap_bytes_held = 0
+        self._prefill_chunks_total = 0
+        # padded prefill compute issued so far, in bucket tokens — the
+        # pow2 bucket width of every prefill/chunk call, cumulative. A
+        # per-step delta of this IS the step's prefill compute cost
+        # (padding included), which work-weighted virtual clocks charge
+        # time by (see loadgen.SoakConfig.step_cost)
+        self.prefill_bucket_tokens_total = 0
         if spec_decode is not None:
             self.set_speculation(spec_decode)
         self._register_census_owners()
@@ -369,12 +488,16 @@ class ServingEngine:
         eos_token_id: Optional[int] = None,
         request_id: str = "",
         adapter: Optional[str] = None,
+        priority: int = 0,
     ) -> str:
         """Enqueue one request; returns its id. ``prompt`` is a token-id
         sequence. The request is admitted into a slot by a later
         :meth:`step` as soon as a seat AND its full block reservation are
         available — and, when ``adapter`` names a tenant, once that
-        adapter is resident in the engine's registry."""
+        adapter is resident in the engine's registry. ``priority`` ranks
+        admission (higher first, FIFO within a tier) and, with
+        ``preemption=True``, lets the head evict a strictly
+        lower-priority seat."""
         if adapter is not None and self.adapters is None:
             raise ValueError(
                 f"request names adapter {adapter!r} but the engine was "
@@ -387,6 +510,7 @@ class ServingEngine:
             eos_token_id=eos_token_id,
             request_id=request_id,
             adapter=adapter,
+            priority=priority,
         )
         rid = self.scheduler.submit(req)
         self.span_log.on_submit(
@@ -398,7 +522,9 @@ class ServingEngine:
 
     @property
     def has_work(self) -> bool:
-        return self.scheduler.has_work
+        # swapped-out requests hold no queue entry and no seat, but they
+        # are still the engine's responsibility until resumed + finished
+        return self.scheduler.has_work or bool(self._swapped_reqs)
 
     def trace_counts(self) -> dict:
         """Compiled-program counts, bumped at trace time. After warmup,
@@ -443,21 +569,43 @@ class ServingEngine:
             raise
 
     def _step_inner(self) -> list[TokenEvent]:
-        had_work = self.scheduler.has_work
+        had_work = self.has_work
         events: list[TokenEvent] = []
         for req in self.scheduler.shed_expired():
             self._shed(req)
         for slot in self.scheduler.slots:
             if slot.busy and slot.done:
                 self._finish(slot)
-        for slot in self.scheduler.admit():
+        if self.preemption:
+            self._try_resume()
+        blocked_before = dict(self.scheduler.blocked_reasons)
+        admitted = self.scheduler.admit()
+        if self.preemption and self._maybe_preempt(
+            blocked_before, exclude={s.index for s in admitted}
+        ):
+            # the freed seat/blocks fund the queue head THIS step
+            admitted += self.scheduler.admit()
+        for slot in admitted:
             if self.adapters is not None:
                 # pin the adapter for the request's whole flight — evict
                 # refuses while any seated request still decodes under it
                 self.adapters.acquire(slot.request.adapter)
             self.span_log.on_admit(slot.request.request_id, slot.admit_time)
-            self._prefill_slot(slot, events)
-        active = [s for s in self.scheduler.slots if s.busy and not s.done]
+            if self.prefill_chunk_tokens is None:
+                self._prefill_slot(slot, events)
+            else:
+                self._begin_chunked(slot)
+        if self.prefill_chunk_tokens is not None:
+            self._chunked_prefill_step(events)
+        # mid-prefill seats hold their slot but are not in the decode
+        # batch yet (their row carries lengths=0 this step, so the
+        # compiled decode shape is untouched)
+        active = [
+            s for s in self.scheduler.slots
+            if s.busy and not s.done and not s.mid_prefill
+        ]
+        if active and self.scheduler.chunked_reserve:
+            active = self._grow_active(active)
         if active:
             # speculate only when some slot holds a +k block reservation
             # (granted at admission) — slots seated before speculation
@@ -631,6 +779,7 @@ class ServingEngine:
         tail_len = prompt_len - cached
         bucket = _next_pow2(tail_len)
         self._prefill_buckets.add(bucket)
+        self.prefill_bucket_tokens_total += bucket
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :tail_len] = tail
         table = np.zeros((1, self._max_table), np.int32)
@@ -667,6 +816,415 @@ class ServingEngine:
             self._proposer.prefill_slot(slot)
         self.sampling.set_slot(slot.index, req.temperature)
         self._note_token(slot, token, events)
+
+    # ------------------------------------------------------------------ #
+    # chunked prefill (PR 17): prompt ingestion under a per-step budget
+    # ------------------------------------------------------------------ #
+    def _begin_chunked(self, slot: Slot) -> None:
+        """Seat a request for chunked ingestion: stamp the prefill edge
+        and leave ``cache_len`` at the cached prefix — the slot is now
+        ``mid_prefill`` and :meth:`_chunked_prefill_step` feeds it."""
+        req = slot.request
+        cached = slot.cached_tokens
+        self.span_log.on_prefill(
+            req.request_id, self._now(), cached_prefix_tokens=cached
+        )
+        if cached and self.prefix_cache is not None:
+            self.prefix_cache.tokens_saved_total += cached
+        if self.adapters is not None:
+            self._slot_adapter[slot.index] = self.adapters.slot_of(req.adapter)
+        slot.cache_len = cached
+
+    def _chunked_prefill_step(self, events: list[TokenEvent]) -> None:
+        """Spend this step's prompt-token budget across the mid-prefill
+        seats, shortest remaining prompt first — SRPT within the budget
+        is what moves TTFT p95: a short prompt admitted behind a long
+        one clears the prefill phase in its first step instead of
+        waiting out the giant's full ingestion."""
+        budget = self.prefill_chunk_tokens
+        pref = [s for s in self.scheduler.slots if s.busy and s.mid_prefill]
+        if not pref:
+            return
+        pref.sort(key=lambda s: (
+            len(s.request.prompt) - s.cache_len, s.admit_time, s.index
+        ))
+        preempted = False  # at most one chunk-funding preemption per step
+        for slot in pref:
+            if budget <= 0:
+                break
+            if not slot.busy or not slot.mid_prefill:
+                continue  # victimized by an earlier stall's preemption
+            remaining = len(slot.request.prompt) - slot.cache_len
+            chunk = min(remaining, budget)
+            if self._prefill_chunk(slot, chunk, events):
+                budget -= chunk
+                continue
+            # the chunk's blocks can't be funded. Without preemption the
+            # seat just waits for the pool to drain — but with it, a
+            # wedged prefill is the worst failure mode chunk-aware
+            # admission can produce (every seat mid-prefill, pool
+            # exhausted, nothing decoding, nothing ever freed), so park
+            # the least-progressed seat (often this very one: a barely
+            # started giant is the cheapest swap and frees the most
+            # future demand). Its seat and blocks fund the shorter
+            # prefills and the queue; it resumes when the pool drains.
+            if self.preemption and not preempted:
+                preempted = True
+                victim = self.scheduler.preempt_candidate()
+                if victim is None and not slot.resumed:
+                    victim = slot
+                if victim is not None:
+                    self._preempt(victim, "growth")
+                    if (
+                        victim is not slot
+                        and self._prefill_chunk(slot, chunk, events)
+                    ):
+                        budget -= chunk
+
+    def _prefill_chunk(
+        self, slot: Slot, chunk_len: int, events: list[TokenEvent]
+    ) -> bool:
+        """One bucketed prefill call covering ``chunk_len`` prompt
+        tokens at the slot's true cache offset (``cached_len`` carries
+        it — the SAME compiled pow2-bucket programs the one-shot path
+        uses). Returns False if the chunk's blocks can't be funded."""
+        req = slot.request
+        prompt_len = len(req.prompt)
+        start = slot.cache_len
+        final = start + chunk_len == prompt_len
+        # chunk-aware admission reserved only the first chunk: grow the
+        # table on demand. The final chunk also funds the first decode
+        # write + any lookahead so decode never trips on the boundary.
+        tokens_needed = start + chunk_len + ((1 + slot.lookahead) if final
+                                             else 0)
+        if not self._ensure_blocks(slot, tokens_needed):
+            return False
+        for t in range(start // self.block_size,
+                       (start + chunk_len - 1) // self.block_size + 1):
+            if t in slot.shared:
+                self._cow_block(slot, t)
+        bucket = _next_pow2(chunk_len)
+        self._prefill_buckets.add(bucket)
+        self.prefill_bucket_tokens_total += bucket
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :chunk_len] = req.prompt[start:start + chunk_len]
+        table = np.zeros((1, self._max_table), np.int32)
+        table[0, :len(slot.blocks)] = slot.blocks
+        # intermediate chunks DISCARD their sampled token, so they must
+        # not consume a chain key either — only the final chunk (whose
+        # sample is the request's first token) draws one. A solo
+        # request's outputs are bit-identical chunked or not at any
+        # temperature; batched timelines interleave the shared per-step
+        # decode keys differently, so cross-run parity is greedy-exact.
+        key = self._split_key() if final else self._key
+        self.cache, token = self._prefill_fn(
+            self.params, self.cache, jnp.asarray(ids), jnp.asarray(table),
+            jnp.asarray([chunk_len], jnp.int32),
+            jnp.asarray([start], jnp.int32), key,
+            jnp.asarray([req.temperature], jnp.float32),
+            *self._lora_call_args([self._slot_adapter[slot.index]]),
+        )
+        slot.cache_len = start + chunk_len
+        slot.chunks += 1
+        self._prefill_chunks_total += 1
+        self._tables[slot.index] = table[0]
+        self._tables_dev = None
+        if final:
+            token = int(np.asarray(token)[0])
+            slot.pending = token
+            slot.generated.append(token)
+            if self.prefix_cache is not None and slot.chunks == 1:
+                # single-chunk == the unchunked bucket width, so the
+                # content is canonical; multi-chunk prefills stay out of
+                # the index (their blocks were written at per-chunk
+                # bucket widths)
+                self.prefix_cache.publish(
+                    req.prompt, req.adapter, slot.blocks,
+                    skip_indices=slot.shared | slot.cow_indices,
+                    keys=req.prefix_keys,
+                )
+            slot.first_token_time = self._now()
+            self.span_log.on_first_token(
+                req.request_id, slot.first_token_time, chunks=slot.chunks
+            )
+            if self._proposer is not None and slot.lookahead > 0:
+                self._proposer.prefill_slot(slot)
+            self.sampling.set_slot(slot.index, req.temperature)
+            self._note_token(slot, token, events)
+        return True
+
+    def _ensure_blocks(self, slot: Slot, tokens: int) -> bool:
+        """Grow ``slot``'s block table to cover ``tokens`` cache
+        positions (chunk-aware admission reserves less than the worst
+        case, so chunks and decode grow on demand). False = the pool
+        can't fund the growth right now."""
+        need = self.pool.blocks_for_tokens(tokens) - len(slot.blocks)
+        if need <= 0:
+            return True
+        if not self.pool.can_allocate(need):
+            return False
+        slot.blocks.extend(self.pool.allocate(need))
+        self._tables[slot.index, :len(slot.blocks)] = slot.blocks
+        self._tables_dev = None
+        return True
+
+    def _grow_active(self, active: list[Slot]) -> list[Slot]:
+        """Chunk-aware reservations mean decode itself can hit the pool
+        wall: fund every active slot's next write (+lookahead) before
+        the batch runs, preempting to free blocks where needed."""
+        eligible = []
+        for slot in active:
+            if not slot.busy:
+                continue  # preempted by an earlier seat's growth
+            if self._grow_or_preempt(slot):
+                eligible.append(slot)
+        # a growth preemption may have victimized a seat already vetted
+        return [s for s in eligible if s.busy]
+
+    def _grow_or_preempt(self, slot: Slot) -> bool:
+        tokens = slot.cache_len + 1 + slot.lookahead
+        if self._ensure_blocks(slot, tokens):
+            return True
+        # growth can't allocate: free blocks by preempting. The victim
+        # ordering prefers non-resumed seats (possibly ``slot`` itself);
+        # a RESUMED other seat is the absolute last resort — the one
+        # case the anti-thrash rule yields, because the alternative is
+        # a wedged pool.
+        victim = self.scheduler.preempt_candidate()
+        if victim is None and not slot.resumed:
+            victim = slot
+        if victim is None:
+            others = [
+                s for s in self.scheduler.slots
+                if s.busy and not s.done and s is not slot
+            ]
+            victim = min(
+                others,
+                key=lambda s: (s.request.priority, s.cache_len),
+                default=None,
+            )
+        if victim is None:
+            return False  # sole seat and can't grow: stall this step
+        self._preempt(victim, "growth")
+        if victim is slot:
+            return False
+        return self._ensure_blocks(slot, tokens)
+
+    # ------------------------------------------------------------------ #
+    # preemption with KV swap (PR 17)
+    # ------------------------------------------------------------------ #
+    def _make_swap_fns(self, width: int) -> tuple:
+        """Compiled gather/scatter over every paged-cache leaf (K/V
+        pools AND int8 scale arrays — ``_kv_leaf_info``) for a pow2
+        ``width`` of block ids. Ids are padded with 0, the garbage
+        block, so padded scatter rows are harmless by the same contract
+        invalid decode writes rely on. One trace per width, ever: the
+        zero-retrace contract's swap leg."""
+        info = list(self._kv_leaf_info)
+        traces = self._traces
+
+        def _gather(cache, idx):
+            traces["swap_out"] += 1
+            leaves = jax.tree.leaves(cache)
+            return [
+                jnp.moveaxis(jnp.take(leaves[i], idx, axis=ax), ax, 0)
+                for i, ax in info
+            ]
+
+        def _scatter(cache, idx, *data):
+            traces["swap_in"] += 1
+            leaves = list(jax.tree.leaves(cache))
+            treedef = jax.tree.structure(cache)
+            for (i, ax), d in zip(info, data):
+                leaf = leaves[i]
+                lead = (slice(None),) * ax
+                leaves[i] = leaf.at[lead + (idx,)].set(jnp.moveaxis(d, 0, ax))
+            return jax.tree.unflatten(treedef, leaves)
+
+        return jax.jit(_gather), jax.jit(_scatter)
+
+    def _swap_fns_for(self, n: int) -> tuple:
+        width = _next_pow2(n)
+        fns = self._swap_fns.get(width)
+        if fns is None:
+            fns = self._swap_fns[width] = self._make_swap_fns(width)
+        return width, fns
+
+    def _swap_out_blocks(self, blocks: list[int]) -> tuple[list, int]:
+        """device_get the contents of ``blocks`` across every paged
+        leaf; returns (host arrays trimmed to len(blocks), total bytes)."""
+        n = len(blocks)
+        width, (gather, _) = self._swap_fns_for(n)
+        idx = np.zeros(width, np.int32)
+        idx[:n] = blocks
+        host = jax.device_get(gather(self.cache, jnp.asarray(idx)))
+        data = [np.asarray(d[:n]) for d in host]
+        return data, sum(d.nbytes for d in data)
+
+    def _restore_blocks(self, blocks: list[int], data: list) -> None:
+        """Scatter saved host images into freshly allocated ``blocks``
+        (same order as the gather: table position i -> image i)."""
+        n = len(blocks)
+        width, (_, scatter) = self._swap_fns_for(n)
+        idx = np.zeros(width, np.int32)
+        idx[:n] = blocks
+        padded = []
+        for d in data:
+            if width > n:
+                d = np.concatenate(
+                    [d, np.zeros((width - n,) + d.shape[1:], d.dtype)]
+                )
+            padded.append(jnp.asarray(d))
+        self.cache = scatter(self.cache, jnp.asarray(idx), *padded)
+
+    def _preempt(self, slot: Slot, reason: str) -> None:
+        """Swap ``slot`` out to host RAM: gather its blocks' contents
+        (shared blocks included — restore must not depend on the cached
+        chain surviving), park the request + images in the swap area,
+        release the seat. The request's span stays OPEN (state
+        "preempted"); its queue/TTFT clocks keep their original
+        stamps."""
+        req = slot.request
+        data, nbytes = self._swap_out_blocks(slot.blocks)
+        entry = _SwappedRequest(
+            request=req,
+            generated=list(slot.generated),
+            pending=slot.pending,
+            cache_len=slot.cache_len,
+            n_blocks=len(slot.blocks),
+            data=data,
+            chunks=slot.chunks,
+            preempted_count=slot.preempted_count + 1,
+            admit_time=slot.admit_time,
+            first_token_time=slot.first_token_time,
+            cached_tokens=slot.cached_tokens,
+            swap_bytes=nbytes,
+            preempt_time=self._now(),
+        )
+        self._swapped_reqs.append(entry)
+        self._swap_bytes_held += nbytes
+        self._preempt_counts[reason] = self._preempt_counts.get(reason, 0) + 1
+        self.pool.swap_out(slot.blocks)
+        slot.blocks = []  # swap_out released them: release() must not re-free
+        self.span_log.on_preempt(req.request_id, entry.preempt_time)
+        self._tele(
+            "record_preempt",
+            request_id=req.request_id,
+            reason=reason,
+            blocks=entry.n_blocks,
+            swap_bytes=nbytes,
+            cache_len=entry.cache_len,
+            priority=req.priority,
+        )
+        self.sampling.clear_slot(slot.index)
+        self._tables[slot.index] = 0
+        self._tables_dev = None
+        self._slot_adapter[slot.index] = 0
+        if self._proposer is not None:
+            self._proposer.release(slot.index)
+        if self.adapters is not None:
+            self.adapters.release(req.adapter)
+        self.scheduler.release(slot)  # frees the cow_spare, clears the seat
+
+    def _try_resume(self) -> None:
+        """Re-seat swapped requests, oldest first, while a free slot AND
+        their block footprint are available. Resume never preempts —
+        swapped work re-enters only on genuinely free capacity."""
+        if not self._swapped_reqs:
+            return
+        free_slots = [s for s in self.scheduler.slots if not s.busy]
+        while self._swapped_reqs and free_slots:
+            entry = self._swapped_reqs[0]
+            req = entry.request
+            if self.adapters is not None and not self.adapters.resident(
+                req.adapter
+            ):
+                break  # oldest-first: no resume reordering around tenants
+            n = entry.n_blocks
+            if self.scheduler.chunked_reserve:
+                total = n  # grow on demand; growth has the preempt escape
+            else:
+                # full-reservation mode: restore the no-mid-flight-OOM
+                # guarantee before the request decodes again
+                total = max(n, self.pool.blocks_for_tokens(
+                    len(req.prompt) + req.max_new_tokens
+                ))
+            if not self.pool.can_allocate(total):
+                break
+            slot = free_slots.pop(0)
+            self._swapped_reqs.pop(0)
+            self._resume(slot, entry, total - n)
+
+    def _resume(
+        self, slot: Slot, entry: _SwappedRequest, extra: int
+    ) -> None:
+        req = entry.request
+        blocks = self.pool.swap_in(entry.n_blocks)
+        self._restore_blocks(blocks, entry.data)
+        if extra > 0:
+            blocks = blocks + self.pool.allocate(extra)
+        slot.clear()
+        slot.request = req
+        slot.blocks = blocks
+        slot.cache_len = entry.cache_len
+        slot.generated = list(entry.generated)
+        slot.pending = entry.pending
+        slot.chunks = entry.chunks
+        slot.preempted_count = entry.preempted_count
+        slot.resumed = True
+        slot.cached_tokens = entry.cached_tokens
+        slot.admit_time = entry.admit_time
+        slot.first_token_time = entry.first_token_time
+        # restored images live in different block ids than anything the
+        # content index knows: keep every position out of it
+        slot.cow_indices = set(range(len(blocks)))
+        slot.lookahead = 0  # the draft cache was lost at swap-out
+        if self.adapters is not None:
+            self.adapters.acquire(req.adapter)
+            self._slot_adapter[slot.index] = self.adapters.slot_of(req.adapter)
+        self.sampling.set_slot(slot.index, req.temperature)
+        self._tables[slot.index] = 0
+        self._tables[slot.index, :len(blocks)] = blocks
+        self._tables_dev = None
+        self._swap_bytes_held -= entry.swap_bytes
+        self._resumes_total += 1
+        self.span_log.on_resume(req.request_id, self._now())
+
+    def _maybe_preempt(self, blocked_before: dict, exclude=()) -> bool:
+        """At most ONE head-funding preemption per step, and only when
+        this step's admission actually blocked. Priority preemption
+        victimizes any strictly-less-important seat; same-priority
+        "pool" preemption fires only when a deadline exists and the
+        head has burned half of it (pausing a seated request to seat an
+        equal is otherwise pure churn)."""
+        sched = self.scheduler
+        if not sched.queue:
+            return False
+        br = sched.blocked_reasons
+        seat_blocked = br["no_free_slot"] > blocked_before["no_free_slot"]
+        pool_blocked = br["pool_exhausted"] > blocked_before["pool_exhausted"]
+        if not (seat_blocked or pool_blocked):
+            return False
+        head = sched.queue[0]
+        victim = sched.preempt_candidate(
+            max_priority=head.priority - 1, exclude=exclude
+        )
+        if victim is not None:
+            self._preempt(victim, "priority")
+            return True
+        if (
+            pool_blocked
+            and sched.max_queue_delay_s is not None
+            and self._now() - head.submit_time
+                > 0.5 * sched.max_queue_delay_s
+        ):
+            victim = sched.preempt_candidate(
+                max_priority=head.priority, exclude=exclude
+            )
+            if victim is not None:
+                self._preempt(victim, "pool")
+                return True
+        return False
 
     def _decode_step(self, active: list[Slot], events: list[TokenEvent]) -> None:
         tokens = np.zeros((self.max_slots, 1), np.int32)
@@ -824,6 +1382,9 @@ class ServingEngine:
                 slot.spec_accepted / slot.spec_proposed
                 if slot.spec_proposed else None
             ),
+            # PR 17: how turbulent this request's flight was
+            "preempted_count": slot.preempted_count,
+            "prefill_chunks": slot.chunks,
         }
         self.stats.add(record)
         self._tele("record_serve", **record)
@@ -950,6 +1511,18 @@ class ServingEngine:
                 self._spec_accepted_total / self._spec_proposed_total
                 if self._spec_proposed_total else 0.0
             ),
+            # PR 17 capacity plane: swap ledger, preempt/resume rates,
+            # chunk throughput, and the per-token KV cost int8 halves
+            "swapped_blocks": pool["swapped"],
+            "swapped_requests": len(self._swapped_reqs),
+            "swap_bytes_held": self._swap_bytes_held,
+            "preempts_total": sum(self._preempt_counts.values()),
+            "preempts_priority_total": self._preempt_counts["priority"],
+            "preempts_pool_total": self._preempt_counts["pool"],
+            "preempts_growth_total": self._preempt_counts["growth"],
+            "resumes_total": self._resumes_total,
+            "prefill_chunks_total": self._prefill_chunks_total,
+            "kv_bytes_per_token": self.kv_bytes_per_token,
         }
 
     def _sample_gauges(self) -> None:

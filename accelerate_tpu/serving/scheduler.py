@@ -49,6 +49,11 @@ class Request:
     temperature: float = 0.0
     eos_token_id: Optional[int] = None
     request_id: str = ""
+    # preemption's priority axis: higher admits first (FIFO within a
+    # priority level; 0 = the default tier). A lower-priority SEATED
+    # request can be preempted — KV swapped to host, resumed later —
+    # to fund a higher-priority head (see ServingEngine preemption).
+    priority: int = 0
     # multi-tenant serving: name of the adapter to decode under (None =
     # the base model). Admission gates on the adapter being RESIDENT in
     # the engine's AdapterRegistry.
@@ -103,10 +108,28 @@ class Slot:
     # per-request speculation accounting (accept_rate at finish)
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # chunked prefill: prompt tokens whose KV is written so far (equals
+    # cache_len while prefilling; prefill is done once it reaches the
+    # prompt length) and how many chunks it took (0 = unchunked)
+    chunks: int = 0
+    # preemption: times this request was swapped out, and whether the
+    # current seating is a resume (resumed slots are never re-preempted
+    # — the anti-thrash rule)
+    preempted_count: int = 0
+    resumed: bool = False
 
     @property
     def busy(self) -> bool:
         return self.request is not None
+
+    @property
+    def mid_prefill(self) -> bool:
+        """Chunked prefill still ingesting the prompt: the slot holds a
+        seat but is not yet in the decode batch."""
+        return (
+            self.request is not None
+            and self.cache_len < len(self.request.prompt)
+        )
 
     def clear(self) -> None:
         self.request = None
@@ -125,6 +148,9 @@ class Slot:
         self.lookahead = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
+        self.chunks = 0
+        self.preempted_count = 0
+        self.resumed = False
 
 
 class ContinuousScheduler:
@@ -173,6 +199,19 @@ class ContinuousScheduler:
         # width of the engine's per-slot block table (positions past it
         # alias the last entry) — the lookahead clamp's second ceiling
         self.max_table_blocks = max_table_blocks
+        # chunked prefill: when set (by ServingEngine), admission may
+        # reserve only the FIRST chunk's prompt blocks instead of the
+        # full worst-case footprint — but only with chunked_reserve,
+        # which the engine enables iff preemption is also on (the
+        # mid-flight growth path then has preempt-and-swap as its
+        # can't-allocate escape, preserving the no-mid-flight-OOM
+        # guarantee the full reservation used to provide).
+        self.chunk_tokens: Optional[int] = None
+        self.chunked_reserve = False
+        # sticky: set once any nonzero-priority request is submitted —
+        # the queue then stops being submit-ordered and shed_expired
+        # must scan past the head
+        self._saw_priority = False
         self.shed_counts = {"queue_full": 0, "queue_deadline": 0}
         self.blocked_reasons = {
             "no_free_slot": 0,
@@ -200,7 +239,19 @@ class ContinuousScheduler:
             request.shed_reason = "queue_full"
             self.shed_counts["queue_full"] += 1
             return request.request_id
-        self.queue.append(request)
+        if request.priority != 0:
+            self._saw_priority = True
+        if self._saw_priority and request.priority != 0:
+            # keep the queue (priority desc, submit order asc): walk in
+            # from the tail past lower-priority entries. Priority-0
+            # traffic (the common case) appends in O(1) below — equal
+            # priorities stay strictly FIFO.
+            i = len(self.queue)
+            while i > 0 and self.queue[i - 1].priority < request.priority:
+                i -= 1
+            self.queue.insert(i, request)
+        else:
+            self.queue.append(request)
         return request.request_id
 
     def shed_expired(self) -> list[Request]:
@@ -212,6 +263,20 @@ class ContinuousScheduler:
             return []
         now = self._now()
         shed: list[Request] = []
+        if self._saw_priority:
+            # priority ordering breaks head-is-oldest: full scan (only
+            # once any nonzero-priority request has ever been submitted
+            # — pure-FIFO traffic keeps the O(expired) head scan below)
+            keep: deque[Request] = deque()
+            for req in self.queue:
+                if now - req.submit_time > self.max_queue_delay_s:
+                    req.shed_reason = "queue_deadline"
+                    self.shed_counts["queue_deadline"] += 1
+                    shed.append(req)
+                else:
+                    keep.append(req)
+            self.queue = keep
+            return shed
         while self.queue:
             req = self.queue[0]
             if now - req.submit_time <= self.max_queue_delay_s:
@@ -280,7 +345,6 @@ class ContinuousScheduler:
                 lookahead = max(
                     0, min(self.lookahead_tokens, cap - base_tokens)
                 )
-            need = self.pool.blocks_for_tokens(base_tokens + lookahead)
             shared: list[int] = []
             if self.prefix_cache is not None:
                 if req.prefix_keys is None:
@@ -295,6 +359,20 @@ class ContinuousScheduler:
             # last shared block at prefill time (needs the spare below)
             cached_tokens = min(hit_tokens, len(req.prompt) - 1)
             cow_reserve = 1 if hit_tokens > cached_tokens else 0
+            total_tokens = base_tokens + lookahead
+            if self.chunked_reserve and self.chunk_tokens is not None:
+                # chunked-prefill admission (the PR 17 over-reservation
+                # fix): fund the cached prefix plus ONE chunk instead of
+                # the full worst case — a 2048-token prompt admits on
+                # chunk-budget blocks, not 2048/block_size of them. The
+                # engine grows the table chunk-by-chunk and, when growth
+                # can't allocate, preempts (swap-out) instead of OOMing.
+                reserve_tokens = min(
+                    total_tokens, cached_tokens + self.chunk_tokens
+                )
+            else:
+                reserve_tokens = total_tokens
+            need = self.pool.blocks_for_tokens(reserve_tokens)
             if shared:
                 # pin the chain BEFORE any allocation can LRU-evict it
                 self.pool.acquire(shared)
@@ -316,6 +394,34 @@ class ContinuousScheduler:
             slot.admit_time = self._now()
             admitted.append(slot)
         return admitted
+
+    def preempt_candidate(
+        self, max_priority: Optional[int] = None, exclude=()
+    ) -> Optional[Slot]:
+        """The slot preemption should victimize, or None.
+
+        Victim order: lowest priority first, then least progress
+        (fewest KV tokens — the cheapest swap and the least work
+        parked). Resumed slots are exempt — a request is preempted at
+        most once per seating generation, so preemption can never
+        ping-pong the same request (the anti-thrash rule). ``max_priority``
+        caps eligible victims (pass ``head.priority`` to never victimize
+        anyone more important than the request being funded);
+        ``exclude`` skips slot indices (e.g. seats admitted this very
+        step)."""
+        cands = [
+            s for s in self.slots
+            if s.busy and not s.done and not s.resumed
+            and s.index not in exclude
+        ]
+        if max_priority is not None:
+            cands = [s for s in cands if s.request.priority <= max_priority]
+        if not cands:
+            return None
+        return min(
+            cands,
+            key=lambda s: (s.request.priority, s.cache_len, -s.index),
+        )
 
     @property
     def active(self) -> list[Slot]:

@@ -54,6 +54,11 @@ class RequestSpan:
     # speculative decoding: accepted/proposed draft tokens over the
     # request's life (None = no drafts were ever proposed for it)
     accept_rate: Optional[float] = None
+    # preemption: times this request was swapped out to host RAM and
+    # re-admitted (0 = it kept its seat for its whole flight)
+    preempted_count: int = 0
+    # chunked prefill: chunks the prompt ingested in (0 = unchunked)
+    chunked: int = 0
 
     @property
     def terminal(self) -> bool:
@@ -89,6 +94,8 @@ class RequestSpan:
             "cached_prefix_tokens": self.cached_prefix_tokens,
             "new_tokens": self.new_tokens,
             "accept_rate": self.accept_rate,
+            "preempted_count": self.preempted_count,
+            "chunked": self.chunked,
             "submit_t": self.submit_t,
             "admit_t": self.admit_t,
             "prefill_start_t": self.prefill_start_t,
@@ -156,10 +163,30 @@ class SpanLog:
             span.cached_prefix_tokens = cached_prefix_tokens
         return span
 
-    def on_first_token(self, request_id: str, t: float) -> Optional[RequestSpan]:
+    def on_first_token(
+        self, request_id: str, t: float, chunks: int = 0
+    ) -> Optional[RequestSpan]:
         span = self._open.get(request_id)
         if span is not None:
             span.first_token_t = t
+            span.chunked = chunks
+        return span
+
+    def on_preempt(self, request_id: str, t: float) -> Optional[RequestSpan]:
+        """The request was swapped out to host RAM: the span stays OPEN
+        (it will finish after resume) but records the preemption — a
+        span with preempted_count > 0 and a long prefill→finish gap is
+        how a paused request reads in the trace."""
+        span = self._open.get(request_id)
+        if span is not None:
+            span.preempted_count += 1
+            span.state = "preempted"
+        return span
+
+    def on_resume(self, request_id: str, t: float) -> Optional[RequestSpan]:
+        span = self._open.get(request_id)
+        if span is not None:
+            span.state = "running"
         return span
 
     def on_finish(

@@ -512,6 +512,25 @@ class StepTelemetry:
             "shed", label, {"request_id": request_id, "reason": reason, **fields}
         )
 
+    def record_preempt(
+        self,
+        *,
+        request_id: str,
+        reason: str,
+        label: str = "serve",
+        **fields,
+    ) -> Optional[dict]:
+        """Emit a ``kind="preempt"`` record — one running request swapped
+        out to host RAM to fund a more important one (``reason``:
+        ``priority`` | ``pool`` | ``growth``). Unlike a shed the request
+        is NOT lost — it resumes later bitwise-identical. The Prometheus
+        sink counts these per reason."""
+        return self._record_event(
+            "preempt",
+            label,
+            {"request_id": request_id, "reason": reason, **fields},
+        )
+
     def record_memory(self, *, label: str = "memory", **fields) -> Optional[dict]:
         """Emit a ``kind="memory"`` record — one owner-attributed
         device+host memory sample (census owner breakdown, unowned

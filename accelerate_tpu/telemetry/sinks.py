@@ -150,6 +150,15 @@ shed; emitted by the ServingEngine's span log)::
     spec_tokens_proposed                 int    cumulative drafts proposed
     spec_tokens_accepted                 int    cumulative drafts accepted
     spec_accept_rate                     float  lifetime accepted / proposed
+    swapped_blocks                       int    KV blocks parked in host RAM
+    swapped_requests                     int    preempted requests waiting
+    swap_bytes_held                      int    host bytes of swapped KV
+    preempts_total                       int    cumulative preemptions (+
+    preempts_{priority,pool,growth}_total int   per-reason splits)
+    resumes_total                        int    preempted requests resumed
+    prefill_chunks_total                 int    chunked-prefill calls run
+    kv_bytes_per_token                   float  KV+scale bytes per cached
+                                                token (int8 shrinks this)
 
 ``kind="memory"`` (one per live-buffer census, every
 ``census_interval`` emitted step records — or on demand via
@@ -185,6 +194,21 @@ Prometheus sink counts these as
     queue_s         float  how long it waited before shedding
     prompt_tokens   int    what was refused (capacity forensics)
     max_new_tokens  int
+
+``kind="preempt"`` (one per running request swapped out to host RAM to
+fund a more important one; unlike a shed the request resumes later
+bitwise-identical. The Prometheus sink counts these as
+``{prefix}_serve_preempt_total{reason="..."}``)::
+
+    request_id      str    the victim request
+    reason          str    "priority" (outranked by a higher-priority
+                           arrival) | "pool" (head-of-line aging past
+                           its deadline budget) | "growth" (a running
+                           slot could not fund its next KV block)
+    blocks          int    KV blocks swapped to host
+    swap_bytes      int    host bytes the swapped image occupies
+    cache_len       int    tokens of KV context at preemption
+    priority        int    the victim's priority
 
 ``kind="slo"`` (every ``SLOConfig.interval_steps`` engine steps;
 numeric fields become ``{prefix}_slo_{field}`` gauges)::
@@ -422,6 +446,12 @@ class PrometheusTextSink(TelemetrySink):
         if kind == "shed":
             reason = str(record.get("reason", "unknown"))
             key = (f"{self.prefix}_serve_shed_total", "reason", reason)
+            self._counters[key] = self._counters.get(key, 0.0) + 1.0
+            self._write()
+            return
+        if kind == "preempt":
+            reason = str(record.get("reason", "unknown"))
+            key = (f"{self.prefix}_serve_preempt_total", "reason", reason)
             self._counters[key] = self._counters.get(key, 0.0) + 1.0
             self._write()
             return

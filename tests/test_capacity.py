@@ -1,0 +1,405 @@
+"""Capacity features: chunked prefill, preemption with KV swap, int8 KV.
+
+Three coupled serve-more-users-per-chip levers, each tested against the
+engine's core contracts: chunked prefill must keep decode running every
+step and change NOTHING about greedy outputs or the zero-retrace
+guarantee; preemption must round-trip a victim's KV through host RAM
+bitwise-identically; int8 paged KV must shrink bytes-per-cached-token
+>= 1.8x while greedy outputs stay exact. Plus the pool's swap ledger
+under fuzz (the conservation law extended with the SWAPPED state) and
+the preempt telemetry counter.
+"""
+
+import math
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.models import CausalLM, TransformerConfig
+from accelerate_tpu.serving import BlockPool, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    return cfg, model, params
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def tick(self, dt: float = 1.0) -> None:
+        self.t += dt
+
+    def __call__(self) -> float:
+        return self.t
+
+
+#: random-token prompts with robust greedy logit gaps (the 9-token draw
+#: has a near-tied top-2 at its first step, so int8 quantization noise
+#: can legitimately flip it — parity tests use the first three)
+PROMPT_LENS = (23, 5, 17, 9)
+
+
+def _prompts(cfg, lens=PROMPT_LENS):
+    rng = np.random.default_rng(0)
+    return [rng.integers(1, cfg.vocab_size, size=n).tolist() for n in lens]
+
+
+def _run_all(engine, prompts, max_new_tokens=6, **kw):
+    ids = [engine.add_request(p, max_new_tokens=max_new_tokens, **kw)
+           for p in prompts]
+    while engine.has_work:
+        engine.step()
+    return [engine.result(rid) for rid in ids]
+
+
+# ---------------------------------------------------------------------- #
+# chunked prefill
+# ---------------------------------------------------------------------- #
+def test_chunked_prefill_greedy_parity_zero_retrace(tiny_model):
+    """Chunking is scheduling, not math: the same prompts produce the
+    same greedy tokens chunked or not, and the decode step still
+    compiles exactly once (chunk offsets are traced data)."""
+    cfg, model, params = tiny_model
+    prompts = _prompts(cfg)
+    base = ServingEngine(model, params, max_slots=4, block_size=8)
+    expected = _run_all(base, prompts)
+    assert all(e is not None for e in expected)
+
+    eng = ServingEngine(
+        model, params, max_slots=4, block_size=8, prefill_chunk_tokens=8
+    )
+    got = _run_all(eng, prompts)
+    assert got == expected
+    counts = eng.trace_counts()
+    assert counts["decode"] == 1, "chunked prefill retraced decode"
+    assert counts["prefill"] <= int(math.log2(cfg.max_seq_len))
+    assert eng._prefill_chunks_total >= sum(
+        math.ceil(len(p) / 8) for p in prompts
+    ) - len(prompts)  # at least the unavoidable multi-chunk splits
+    recs = {r["request_id"]: r for r in eng.stats.requests}
+    assert all(r["prefill_chunks"] >= 1 for r in recs.values())
+
+
+def test_chunked_prefill_decode_never_starves(tiny_model):
+    """A long prompt ingesting under a per-step token budget must not
+    stall a decoding neighbour: the active slot emits exactly one token
+    on EVERY step the newcomer spends mid-prefill."""
+    cfg, model, params = tiny_model
+    clock = FakeClock()
+    eng = ServingEngine(
+        model, params, max_slots=2, block_size=8, num_blocks=32,
+        prefill_chunk_tokens=8, now=clock,
+    )
+    a = eng.add_request([1, 2, 3, 4], max_new_tokens=20)
+    for _ in range(2):  # A prefills, then decodes one token
+        eng.step()
+        clock.tick()
+    long_prompt = np.random.default_rng(7).integers(
+        1, cfg.vocab_size, size=33
+    ).tolist()
+    b = eng.add_request(long_prompt, max_new_tokens=2)
+    steps = 0
+    a_tokens_during = 0
+    while True:
+        events = eng.step()
+        clock.tick()
+        steps += 1
+        a_tokens_during += sum(1 for e in events if e.request_id == a)
+        if any(e.request_id == b for e in events):
+            break
+        assert steps < 20, "B never produced a first token"
+    # 33 tokens / 8-token budget = 5 chunked steps; A decoded through all
+    assert steps == math.ceil(33 / 8)
+    assert a_tokens_during == steps
+    while eng.has_work:
+        eng.step()
+        clock.tick()
+    recs = {r["request_id"]: r for r in eng.stats.requests}
+    assert recs[b]["prefill_chunks"] == math.ceil(33 / 8)
+    assert eng.trace_counts()["decode"] == 1
+
+
+def test_chunked_prefill_srpt_orders_short_prompt_first(tiny_model):
+    """Shortest-remaining-prompt-first: a short prompt submitted AFTER a
+    long one still reaches its first token sooner — the budget goes to
+    whoever can clear it fastest."""
+    cfg, model, params = tiny_model
+    clock = FakeClock()
+    eng = ServingEngine(
+        model, params, max_slots=2, block_size=8, num_blocks=32,
+        prefill_chunk_tokens=8, now=clock,
+    )
+    prompts = _prompts(cfg, lens=(17, 5))
+    long_id = eng.add_request(prompts[0], max_new_tokens=2)
+    short_id = eng.add_request(prompts[1], max_new_tokens=2)
+    while eng.has_work:
+        eng.step()
+        clock.tick()
+    recs = {r["request_id"]: r for r in eng.stats.requests}
+    assert recs[short_id]["ttft_s"] < recs[long_id]["ttft_s"]
+
+
+def test_chunked_stall_preempts_instead_of_wedging(tiny_model):
+    """The failure mode chunk-aware admission can produce: every seat
+    mid-prefill, pool exhausted, nothing decoding — so nothing ever
+    frees a block and nothing progresses. With preemption on, a stalled
+    chunk parks the least-progressed prefill (KV swapped to host) so
+    the leader finishes and the pool drains; every request still
+    completes with exact greedy outputs."""
+    cfg, model, params = tiny_model
+    prompts = _prompts(cfg, lens=(40, 39, 38))
+    base = ServingEngine(model, params, max_slots=3, block_size=4,
+                         num_blocks=40)
+    expected = _run_all(base, prompts, max_new_tokens=4)
+    assert all(e is not None for e in expected)
+
+    eng = ServingEngine(
+        model, params, max_slots=3, block_size=4, num_blocks=13,
+        prefill_chunk_tokens=8, preemption=True,
+    )
+    ids = [eng.add_request(p, max_new_tokens=4) for p in prompts]
+    steps = 0
+    while eng.has_work:
+        eng.step()
+        steps += 1
+        assert steps < 300, "engine wedged: mid-prefill seats starved"
+    assert [eng.result(r) for r in ids] == expected
+    assert eng._preempt_counts["growth"] >= 1
+    assert eng._resumes_total == sum(eng._preempt_counts.values()) >= 1
+    stats = eng.pool.stats()
+    assert stats["allocated"] == 0 and stats["swapped"] == 0
+    assert eng.trace_counts()["decode"] == 1
+
+
+# ---------------------------------------------------------------------- #
+# preemption with KV swap
+# ---------------------------------------------------------------------- #
+def test_preempt_swap_resume_bitwise_parity(tiny_model):
+    """A high-priority arrival evicts the low-priority seat; the victim's
+    KV round-trips through host RAM and its final tokens are bitwise
+    identical to an uncontended run. The swap programs compile once per
+    pow2 width; the pool ends drained (nothing leaked, nothing stranded
+    on the host)."""
+    cfg, model, params = tiny_model
+    prompts = _prompts(cfg, lens=(23, 17, 5))
+    base = ServingEngine(model, params, max_slots=2, block_size=4,
+                         num_blocks=33)
+    expected = _run_all(base, prompts)
+
+    eng = ServingEngine(
+        model, params, max_slots=2, block_size=4, num_blocks=13,
+        preemption=True,
+    )
+    victim = eng.add_request(prompts[0], max_new_tokens=6)
+    for _ in range(2):  # seat it, decode a little — KV worth preserving
+        eng.step()
+    urgent = eng.add_request(prompts[1], max_new_tokens=6, priority=5)
+    filler = eng.add_request(prompts[2], max_new_tokens=6)
+    while eng.has_work:
+        eng.step()
+
+    assert [eng.result(r) for r in (victim, urgent, filler)] == expected
+    assert eng._preempt_counts["priority"] == 1
+    assert eng._resumes_total == 1
+    counts = eng.trace_counts()
+    assert counts["swap_out"] == 1 and counts["swap_in"] == 1
+    stats = eng.pool.stats()
+    assert stats["swap_outs_total"] == 1 and stats["swap_ins_total"] == 1
+    assert stats["swapped"] == 0 and stats["allocated"] == 0
+    recs = {r["request_id"]: r for r in eng.stats.requests}
+    assert recs[victim]["preempted_count"] == 1
+    assert recs[urgent]["preempted_count"] == 0
+    assert counts["decode"] == 1, "preemption retraced decode"
+
+
+def test_preemption_off_never_swaps(tiny_model):
+    """Default-off contract: without ``preemption=True`` the same
+    contended workload sees zero preemptions — the urgent request just
+    waits its turn."""
+    cfg, model, params = tiny_model
+    prompts = _prompts(cfg, lens=(23, 17, 5))
+    eng = ServingEngine(model, params, max_slots=2, block_size=4,
+                        num_blocks=13)
+    results = _run_all(eng, prompts)
+    assert all(r is not None for r in results)
+    assert eng._preempt_counts == {"priority": 0, "pool": 0, "growth": 0}
+    assert eng.pool.stats()["swap_outs_total"] == 0
+    assert eng.trace_counts().get("swap_out", 0) == 0
+
+
+# ---------------------------------------------------------------------- #
+# pool: swap ledger under fuzz
+# ---------------------------------------------------------------------- #
+def _invariant(pool: BlockPool, swapped: int) -> bool:
+    """Device conservation (FREE/ALLOCATED/CACHED partition the
+    allocatable blocks) plus the swap ledger: host images are counted
+    OUTSIDE device occupancy and must match ours exactly."""
+    return (
+        pool.num_free + pool.num_allocated + pool.num_cached
+        == pool.num_blocks - 1
+    ) and pool.num_swapped == swapped
+
+
+def test_block_pool_fuzz_with_swap_ops():
+    """Randomized allocate/free/acquire/publish/swap_out/swap_in/
+    swap_drop churn: the extended conservation law holds after EVERY op
+    and no op corrupts a neighbour's refcount."""
+    rng = random.Random(1)
+    pool = BlockPool(num_blocks=17, block_size=4)
+    held: list[int] = []  # one entry per reference we own
+    swapped = 0
+    published = 0
+    for _ in range(3000):
+        op = rng.random()
+        if op < 0.30 and pool.can_allocate(n := rng.randint(1, 3)):
+            held.extend(pool.allocate(n))
+        elif op < 0.45 and held:
+            b = held.pop(rng.randrange(len(held)))
+            pool.free([b])
+        elif op < 0.55 and held:
+            b = held[rng.randrange(len(held))]
+            pool.acquire([b])
+            held.append(b)
+        elif op < 0.65 and held:
+            b = held[rng.randrange(len(held))]
+            pool.publish(b, published.to_bytes(4, "big") * 8)
+            published += 1
+        elif op < 0.80 and held:
+            # preempt: drop one of our references, grow the host ledger
+            b = held.pop(rng.randrange(len(held)))
+            pool.swap_out([b])
+            swapped += 1
+        elif op < 0.90 and swapped:
+            n = rng.randint(1, swapped)
+            if pool.can_allocate(n):
+                held.extend(pool.swap_in(n))
+                swapped -= n
+        elif swapped:
+            n = rng.randint(1, swapped)
+            pool.swap_drop(n)
+            swapped -= n
+        assert _invariant(pool, swapped), "conservation law broken mid-fuzz"
+        counts: dict[int, int] = {}
+        for b in held:
+            counts[b] = counts.get(b, 0) + 1
+        assert all(pool.refcount(b) == n for b, n in counts.items())
+    for b in held:
+        pool.free([b])
+    pool.swap_drop(swapped)
+    assert _invariant(pool, 0)
+    assert pool.num_allocated == 0
+
+
+def test_swap_ledger_rejects_bad_ops():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.swap_out([3])
+    with pytest.raises(ValueError, match="swap_in"):
+        pool.swap_in(1)
+    with pytest.raises(ValueError, match="swap_drop"):
+        pool.swap_drop(1)
+    blocks = pool.allocate(2)
+    pool.swap_out(blocks)
+    assert pool.num_swapped == 2 and pool.num_free == 7
+    back = pool.swap_in(2)
+    assert len(back) == 2 and pool.num_swapped == 0
+
+
+# ---------------------------------------------------------------------- #
+# int8 paged KV
+# ---------------------------------------------------------------------- #
+def test_int8_kv_greedy_parity(tiny_model):
+    """Per-block-scaled int8 KV must not change greedy outputs on
+    prompts whose argmax has a healthy logit gap (quantization noise may
+    flip genuine near-ties; that is the documented contract)."""
+    cfg, model, params = tiny_model
+    prompts = _prompts(cfg, lens=(23, 5, 17))
+    base = ServingEngine(model, params, max_slots=4, block_size=8,
+                         num_blocks=16)
+    expected = _run_all(base, prompts)
+    eng = ServingEngine(model, params, max_slots=4, block_size=8,
+                        num_blocks=16, kv_dtype="int8")
+    assert _run_all(eng, prompts) == expected
+    assert eng.trace_counts()["decode"] == 1
+
+
+def test_int8_kv_capacity_arithmetic(tiny_model):
+    """The headline: int8 KV fits >= 1.8x the concurrent requests in the
+    same HBM budget. bytes/token drops from 2*kvH*hd*itemsize to
+    2*kvH*hd*1 + 2*4 (the fp32 per-token scales) per layer."""
+    cfg, model, params = tiny_model
+    fp = ServingEngine(model, params, max_slots=2, block_size=8,
+                       num_blocks=16)
+    i8 = ServingEngine(model, params, max_slots=2, block_size=8,
+                       num_blocks=16, kv_dtype="int8")
+    kv_heads, head_dim = cfg.num_kv_heads, cfg.head_dim
+    itemsize = fp.kv_bytes_per_token / (
+        cfg.num_layers * 2 * kv_heads * head_dim
+    )
+    assert itemsize in (2.0, 4.0)  # native KV is bf16/fp32, nothing else
+    per_layer_i8 = 2 * kv_heads * head_dim * 1 + 2 * 4
+    assert i8.kv_bytes_per_token == cfg.num_layers * per_layer_i8
+    ratio = fp.kv_bytes_per_token / i8.kv_bytes_per_token
+    assert ratio >= 1.8
+    # same HBM budget, same per-request token footprint: strictly more
+    # seats. 64 MiB budget, 512-token requests:
+    budget, tokens = 64 << 20, 512
+    fits_fp = budget // int(fp.kv_bytes_per_token * tokens)
+    fits_i8 = budget // int(i8.kv_bytes_per_token * tokens)
+    assert fits_i8 >= 1.8 * fits_fp
+
+
+def test_kv_dtype_validation(tiny_model):
+    cfg, model, params = tiny_model
+    with pytest.raises(ValueError, match="kv_dtype"):
+        ServingEngine(model, params, max_slots=2, kv_dtype="fp8")
+    with pytest.raises(ValueError, match="prefill_chunk_tokens"):
+        ServingEngine(model, params, max_slots=2, prefill_chunk_tokens=0)
+
+
+# ---------------------------------------------------------------------- #
+# composition
+# ---------------------------------------------------------------------- #
+def test_all_three_features_compose(tiny_model):
+    """Chunked prefill + preemption + int8 KV together produce the same
+    greedy outputs as int8 alone — the levers are orthogonal."""
+    cfg, model, params = tiny_model
+    prompts = _prompts(cfg)
+    ref = ServingEngine(model, params, max_slots=4, block_size=8,
+                        num_blocks=24, kv_dtype="int8")
+    expected = _run_all(ref, prompts)
+    eng = ServingEngine(
+        model, params, max_slots=4, block_size=8, num_blocks=24,
+        prefill_chunk_tokens=8, preemption=True, kv_dtype="int8",
+    )
+    assert _run_all(eng, prompts) == expected
+    assert eng.trace_counts()["decode"] == 1
+
+
+# ---------------------------------------------------------------------- #
+# telemetry
+# ---------------------------------------------------------------------- #
+def test_preempt_counter_reaches_prometheus_sink():
+    from accelerate_tpu.telemetry import PrometheusTextSink, StepTelemetry
+
+    tel = StepTelemetry(True)
+    sink = PrometheusTextSink(path=None)
+    tel.add_sink(sink)
+    tel.record_preempt(request_id="r1", reason="priority", blocks=8,
+                       swap_bytes=4096, cache_len=25, priority=0)
+    tel.record_preempt(request_id="r2", reason="growth")
+    tel.record_preempt(request_id="r3", reason="priority")
+    text = sink.render()
+    assert "# TYPE accelerate_tpu_serve_preempt_total counter" in text
+    assert 'accelerate_tpu_serve_preempt_total{reason="priority"} 2.0' in text
+    assert 'accelerate_tpu_serve_preempt_total{reason="growth"} 1.0' in text
