@@ -35,12 +35,12 @@ FAST_FILES = \
   tests/test_slice_mesh.py tests/test_adapters.py \
   tests/test_prefix_cache.py tests/test_speculation.py \
   tests/test_profiling.py tests/test_loadgen.py \
-  tests/test_capacity.py
+  tests/test_capacity.py tests/test_router.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
   diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke \
   slice-smoke kernels-smoke lora-smoke prefix-smoke spec-smoke mem-smoke \
-  soak-smoke capacity-smoke
+  soak-smoke capacity-smoke router-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -212,6 +212,16 @@ capacity-smoke:
 # trace, and bounded memory in every ring (the e2e runs here, not tier 1)
 soak-smoke:
 	JAX_PLATFORMS=cpu $(PYTEST) -q tests/test_loadgen.py
+
+# fleet serving acceptance on CPU (~15s): router unit tier on fake
+# clocks + engines (least-loaded under skew, prefix-affinity beats
+# round-robin on warm hits, session spill on drain, stale snapshots
+# never wedge, replica_kill/replica_slow accounting) plus real-engine
+# smokes — drain finishes seats while shedding new work, the prefix
+# digest is tenant-scoped, and a 3-replica fleet produces identical
+# outputs under affinity vs round-robin with strictly more warm hits
+router-smoke:
+	JAX_PLATFORMS=cpu $(PYTEST) -q tests/test_router.py
 
 # diagnostics end-to-end on CPU: a tiny train loop with an injected slow
 # step and an injected NaN gradient runs with the flight recorder on,

@@ -1622,6 +1622,277 @@ def _run_serve_soak(cfg, max_slots: int, block_size: int,
     }
 
 
+def _run_fleet_soak(cfg, max_slots: int, block_size: int,
+                    target_requests: int, seed: int,
+                    partial: Optional[PartialWriter] = None):
+    """Fleet serving line: the soak harness drives a FOUR-replica fleet
+    through the PR 18 router, entirely on the virtual clock (step_dt_s)
+    so the multi-replica program costs engine steps, not host seconds.
+
+    Three policy arms replay the SAME templated-cohort trace (90% of
+    requests open with one of four block-aligned cohort prefixes —
+    production templated traffic) against fresh replicas:
+
+      round_robin     — the placement baseline,
+      least_loaded    — live-gauge admission,
+      prefix_affinity — cached-chain overlap minus a load penalty.
+
+    Acceptance bar: prefix-affinity shows STRICTLY higher fleet-wide
+    warm-prefix hit rate AND no-worse goodput@SLO than round-robin —
+    affinity concentrates each cohort's chain on one replica instead of
+    duplicating the prefill N ways. A fourth arm re-runs affinity with
+    ``replica_kill@0:replica=1`` mid-soak and reports the re-route
+    ledger (requeued vs lost) and measured time-to-recover. Every arm
+    also asserts the per-replica zero-retrace contract: decode compiled
+    once per replica during priming and never again.
+
+    Headline: affinity-arm fleet goodput@SLO; ``vs_baseline`` is
+    affinity/round-robin goodput (>= 1 means affinity is no worse while
+    winning on warm hits).
+    """
+    import os
+
+    from accelerate_tpu.loadgen import (
+        Phase,
+        SoakClock,
+        SoakConfig,
+        SoakHarness,
+        WorkloadConfig,
+    )
+    from accelerate_tpu.models import CausalLM, count_params
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.router import FleetRouter, InProcessReplica
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.serving.telemetry import ServeStats
+
+    partial = partial or _noop_writer("fleet_soak")
+    _reset_state()
+    model = CausalLM(cfg)
+    abstract = unbox_params(
+        jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )
+        )
+    )["params"]
+    leaves, treedef = jax.tree_util.tree_flatten(abstract)
+    keys = jax.random.split(jax.random.PRNGKey(0), len(leaves))
+
+    @jax.jit
+    def init_bf16():
+        return jax.tree_util.tree_unflatten(treedef, [
+            jax.random.normal(k, l.shape, jnp.bfloat16)
+            * (0.02 if l.ndim > 1 else 1.0)
+            for k, l in zip(keys, leaves)
+        ])
+
+    params = init_bf16()
+    n_params = count_params(params)
+
+    n_replicas = 4
+    prefix_tokens = 3 * block_size  # cohort prefix: 3 full chain blocks
+    workload = WorkloadConfig(
+        vocab_size=cfg.vocab_size,
+        num_cohorts=4,
+        prefix_tokens=prefix_tokens,
+        cohort_fraction=0.9,
+        prompt_tokens_min=2,
+        prompt_tokens_median=4,
+        prompt_tokens_max=2 * block_size,
+        output_tokens_min=2,
+        output_tokens_median=6,
+        output_tokens_max=16,
+        max_total_tokens=cfg.max_seq_len,
+    )
+
+    ab_dt = 0.01  # virtual seconds per fleet step (one step per replica)
+    # analytic FLEET seat throughput in requests per virtual second
+    vcap = n_replicas * max_slots / (
+        (2 + workload.output_tokens_median) * ab_dt
+    )
+    # unit sized so one policy arm offers ~= target_requests:
+    # warmup(0.25c, u) + soak(0.55c, 2u) = 1.35 * c * u requests
+    u = max(0.2, target_requests / (1.35 * vcap))
+    policy_phases = (
+        Phase("warmup", "warmup", u, 0.25 * vcap),
+        Phase("soak", "soak", 2 * u, 0.55 * vcap),
+    )
+    kill_phases = (
+        Phase("warmup", "warmup", u, 0.25 * vcap),
+        Phase("soak", "soak", u, 0.55 * vcap),
+        Phase("fault", "fault", u, 0.55 * vcap),
+        Phase("recovery", "recovery", 2 * u, 0.55 * vcap),
+    )
+
+    max_prompt = prefix_tokens + workload.prompt_tokens_max
+    prime_lens = []
+    m = 2
+    while m < 2 * max_prompt and m + 2 <= cfg.max_seq_len:
+        prime_lens.append(min(m, max_prompt))
+        m *= 2
+
+    def _prime(eng):
+        """Compile every prefill bucket the trace can hit plus the one
+        decode program BEFORE the arm starts, then reset stats and the
+        prefix index — arms measure placement on cold caches, and the
+        zero-retrace delta is taken from this point."""
+        rng_p = np.random.default_rng(seed + 99)
+        for n in prime_lens:
+            eng.add_request(
+                rng_p.integers(1, workload.vocab_size, size=n).tolist(),
+                max_new_tokens=2,
+            )
+        while eng.has_work:
+            eng.step()
+        eng.set_prefix_cache(False)
+        eng.set_prefix_cache(True, "fleet-bench")
+        eng.stats = ServeStats()
+
+    def _arm(name, policy, phases, fault=""):
+        clock = SoakClock()
+        engines = []
+        for i in range(n_replicas):
+            eng = ServingEngine(
+                model, params, max_slots=max_slots,
+                block_size=block_size, now=clock,
+                prefix_cache=True, model_fingerprint="fleet-bench",
+            )
+            _prime(eng)
+            engines.append(eng)
+        primed = [dict(e.trace_counts()) for e in engines]
+        router = FleetRouter(
+            [InProcessReplica(f"r{i}", e) for i, e in enumerate(engines)],
+            policy=policy, now=clock,
+        )
+        arm_path = (
+            os.path.join(
+                os.path.dirname(partial.path),
+                f"soak-report-fleet-{name}.json",
+            ) if partial.path else None
+        )
+        arm_cfg = SoakConfig(
+            workload=workload, phases=phases, seed=seed + 17,
+            step_dt_s=ab_dt, fault_specs=fault, report_path=arm_path,
+            drain_grace_s=60.0, label=f"fleet_soak_{name}",
+        )
+        rep = SoakHarness(router, arm_cfg, clock=clock).run()
+        cache = [e.prefix_cache.stats() for e in engines]
+        out = {
+            "report": rep,
+            "goodput": rep["headline"]["goodput_tokens_per_s_at_slo"],
+            "warm_lookups": sum(c["lookups"] for c in cache),
+            "warm_hits": sum(c["hits"] for c in cache),
+            "prefill_tokens_saved": sum(
+                c["prefill_tokens_saved_total"] for c in cache
+            ),
+            # per-replica zero-retrace: decode compiles since priming
+            "decode_retraces": sum(
+                e.trace_counts().get("decode", 0) - p.get("decode", 0)
+                for e, p in zip(engines, primed)
+            ),
+            "router": rep.get("router") or {},
+            "report_path": arm_path,
+        }
+        out["warm_hit_rate"] = (
+            out["warm_hits"] / out["warm_lookups"]
+            if out["warm_lookups"] else 0.0
+        )
+        partial.update(
+            phase=f"fleet_{name}",
+            metric="fleet_goodput_tokens_per_s_at_slo",
+            value=out["goodput"], unit="tokens/s",
+            extra={"warm_hit_rate": round(out["warm_hit_rate"], 4)},
+        )
+        return out
+
+    t0 = time.perf_counter()
+    arms = {
+        name: _arm(name, name, policy_phases)
+        for name in ("round_robin", "least_loaded", "prefix_affinity")
+    }
+    kill = _arm(
+        "replica_kill", "prefix_affinity", kill_phases,
+        fault="replica_kill@0:replica=1",
+    )
+    fleet_wall_s = time.perf_counter() - t0
+
+    rr, affinity = arms["round_robin"], arms["prefix_affinity"]
+    fault_rep = kill["report"]["fault"]
+
+    def _arm_extra(a):
+        return {
+            "goodput_tokens_per_s_at_slo": (
+                round(a["goodput"], 1) if a["goodput"] is not None else None
+            ),
+            "warm_hit_rate": round(a["warm_hit_rate"], 4),
+            "warm_hits": a["warm_hits"],
+            "warm_lookups": a["warm_lookups"],
+            "prefill_tokens_saved": a["prefill_tokens_saved"],
+            "decode_retraces": a["decode_retraces"],
+            "requests_finished": a["report"]["requests_finished"],
+            "requests_shed": a["report"]["requests_shed"],
+            "routed_by_replica": {
+                r["name"]: r["routed"]
+                for r in a["router"].get("replicas") or []
+            },
+        }
+
+    return {
+        "metric": "fleet_goodput_tokens_per_s_at_slo",
+        "value": round(affinity["goodput"] or 0.0, 1),
+        "unit": "tokens/s",
+        # acceptance bar: affinity holds goodput while winning warm
+        # hits — >= 1 means no-worse than the round-robin baseline
+        "vs_baseline": (
+            round(affinity["goodput"] / rr["goodput"], 3)
+            if affinity["goodput"] and rr["goodput"] else None
+        ),
+        "extra": {
+            "n_replicas": n_replicas,
+            "max_slots_per_replica": max_slots,
+            "block_size": block_size,
+            "cohort_fraction": workload.cohort_fraction,
+            "prefix_tokens": prefix_tokens,
+            "arms": {name: _arm_extra(a) for name, a in arms.items()},
+            "affinity_vs_rr_warm_hit_rate": (
+                round(affinity["warm_hit_rate"] - rr["warm_hit_rate"], 4)
+            ),
+            "affinity_beats_rr_on_warm_hits": (
+                affinity["warm_hits"] > rr["warm_hits"]
+            ),
+            "decode_retraces_all_arms": sum(
+                a["decode_retraces"] for a in arms.values()
+            ) + kill["decode_retraces"],
+            # replica_kill chaos arm: re-route damage + recovery
+            "kill_goodput_tokens_per_s_at_slo": (
+                round(kill["goodput"], 1)
+                if kill["goodput"] is not None else None
+            ),
+            "kill_requests_requeued": (
+                kill["router"].get("requests_requeued")
+            ),
+            "kill_requests_lost": kill["router"].get("requests_lost"),
+            "kill_rerouted_total": kill["router"].get("rerouted_total"),
+            "kill_replicas_alive": kill["router"].get("replicas_alive"),
+            "kill_sheds_in_window": fault_rep["sheds_in_window"],
+            "kill_slo_violations_in_window": (
+                fault_rep["slo_violations_in_window"]
+            ),
+            "kill_recovery_s": fault_rep["recovery_s"],
+            "kill_recovered": fault_rep["recovered"],
+            "kill_report_path": kill["report_path"],
+            "report_paths": {
+                name: a["report_path"] for name, a in arms.items()
+            },
+            "fleet_wall_s": round(fleet_wall_s, 3),
+            "virtual_capacity_rps": round(vcap, 1),
+            "unit_s": round(u, 3),
+            "params": n_params,
+            "device": _device_kind(),
+        },
+    }
+
+
 def _run_overhead(cfg, batch_size: int, seq: int, iters: int, warmup: int,
                   partial: Optional[PartialWriter] = None):
     """Telemetry+diagnostics ON-vs-OFF A/B: the harness proving ITSELF
@@ -2038,6 +2309,13 @@ def result_line(variant, partial: Optional[PartialWriter] = None) -> dict:
         productive_s = (
             rec["extra"]["soak_wall_s"] + rec["extra"]["calib_wall_s"]
         )
+    elif kind == "fleet_soak":
+        max_slots, block_size, n_requests, seed = batch_size, seq, iters, warmup
+        rec = _run_fleet_soak(
+            cfg, max_slots, block_size, n_requests, seed, partial=partial
+        )
+        rec["extra"].update(probe())
+        productive_s = rec["extra"]["fleet_wall_s"]
     elif kind == "lora":
         rec = _run_lora(cfg, batch_size, seq, iters, warmup, partial=partial)
         rec["extra"].update(probe())

@@ -25,7 +25,7 @@ ENV_ITERS = "ACCELERATE_TPU_BENCH_ITERS"  # test/debug: stretch train loops
 @dataclass(frozen=True)
 class Variant:
     name: str
-    kind: str  # "train" | "ckpt" | "accum" | "decode" | "decode_load" | "serve" | "serve_soak" | "overhead" | "lora"
+    kind: str  # "train" | "ckpt" | "accum" | "decode" | "decode_load" | "serve" | "serve_soak" | "fleet_soak" | "overhead" | "lora"
     priority: int
     group: str
     args: tuple = field(default_factory=tuple)
@@ -174,6 +174,16 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
             # target_requests, seed)
             _variant("serve_soak", "serve_soak", 4, "serve",
                      (tiny, 4, 8, 96, 0), default_estimate_s=240),
+            # fleet serving: FOUR in-process replicas behind the router,
+            # all on ONE virtual clock (step_dt_s), so the whole
+            # multi-replica program is host-speed-independent. Three
+            # policy arms (round_robin / least_loaded / prefix_affinity)
+            # replay the SAME templated-cohort trace, plus a
+            # replica_kill chaos arm measuring re-route damage and
+            # time-to-recover. args: (cfg, max_slots_per_replica,
+            # block_size, target_requests_per_arm, seed)
+            _variant("fleet_soak", "fleet_soak", 5, "serve",
+                     (tiny, 2, 8, 64, 0), default_estimate_s=180),
             _variant("ckpt", "ckpt", 3, "ckpt", (tiny, 4, 64, 8, 2),
                      fast=True, default_estimate_s=15),
             # adapter-only vs full fine-tune economics + the multi-tenant
@@ -311,6 +321,12 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
         # this size — the estimate covers them.
         _variant("serve_soak", "serve_soak", 4, "decode",
                  (decode, 4, 16, 64, 0), default_estimate_s=1200),
+        # fleet serving on the ~5.5B decode model: 4 in-process replicas
+        # per arm share the child's resident-weights budget — each arm
+        # compiles its replicas' programs once (virtual clock hides the
+        # pauses); 4 arms x 4 replicas drive the estimate
+        _variant("fleet_soak", "fleet_soak", 5, "decode",
+                 (decode, 2, 16, 48, 0), default_estimate_s=1600),
         _variant("moe", "train", 3, "moe", (moe, 16, 1024, 20, 3),
                  default_estimate_s=600),
         _variant("longseq", "train", 3, "longseq", (longseq, 1, 8192, 8, 2),

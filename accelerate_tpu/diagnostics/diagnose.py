@@ -557,6 +557,35 @@ def format_report(report: dict) -> str:
                     else "  NOT RECOVERED"
                 )
             )
+        router = rep.get("router") or {}
+        if router:
+            lines.append(
+                f"  fleet: policy={router.get('policy')}"
+                f" replicas={router.get('replicas_alive')}"
+                f"/{router.get('replicas_total')} alive"
+                f" routed={router.get('routed_total') or 0}"
+                f" rerouted={router.get('rerouted_total') or 0}"
+                f" (requeued={router.get('requests_requeued') or 0}"
+                f" lost={router.get('requests_lost') or 0})"
+                + (
+                    f" spills={router['session_spills_total']}"
+                    if router.get("session_spills_total")
+                    else ""
+                )
+                + (
+                    f" stale_routes={router['stale_snapshot_routes_total']}"
+                    if router.get("stale_snapshot_routes_total")
+                    else ""
+                )
+            )
+            per = ", ".join(
+                f"{r.get('name')}={r.get('routed')}"
+                + ("(dead)" if r.get("state") == "dead" else "")
+                + ("(draining)" if r.get("state") == "draining" else "")
+                for r in router.get("replicas") or []
+            )
+            if per:
+                lines.append(f"    placement: {per}")
         top_shed = sorted(
             (rep.get("shed_totals") or {}).items(), key=lambda kv: -kv[1]
         )[:3]

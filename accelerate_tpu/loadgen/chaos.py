@@ -17,6 +17,14 @@ damage measured instead of hoped about:
   are refcount-protected and survive — that invariant is part of what
   the soak verifies). :meth:`release` invokes the ``restore`` callback
   so the harness can re-load its tenants and recovery is measurable.
+* ``replica_kill@step:replica=N`` / ``replica_slow@step:replica=N:secs=S``
+  — fleet faults: when the harness's "engine" is a
+  :class:`~accelerate_tpu.router.FleetRouter`, kill marks replica N
+  dead (unadmitted queue re-routed to survivors, seated requests lost
+  — both counted in the router ledger) and slow freezes replica N's
+  step loop for S seconds so placement must route around it. Against a
+  single engine both are skipped with an event (``not_a_fleet``) —
+  existing soaks can never be broken by a fleet spec.
 
 Handlers install on a :class:`FaultInjector` via ``install_handler`` —
 spec *steps* are engine steps, and the soak harness shifts them to be
@@ -107,7 +115,13 @@ class ChaosAdapter:
         self._event("stall_decode", step=spec.step, secs=secs)
 
     def _on_pool_pressure(self, spec: FaultSpec) -> None:
-        pool = self.engine.pool
+        pool = getattr(self.engine, "pool", None)
+        if pool is None:
+            # a fleet router has no single pool; per-replica pressure
+            # would need per-replica specs (not modeled yet)
+            self._event("pool_pressure", step=spec.step, pinned=0,
+                        skipped="no_pool")
+            return
         n = pool.num_free // 2
         if n < 1:
             self._event("pool_pressure", step=spec.step, pinned=0,
@@ -171,4 +185,39 @@ class ChaosAdapter:
         self._event(
             "adapter_churn", step=spec.step, loads=loads,
             evictions=registry.evict_total - evict_before,
+        )
+
+    # -- fleet faults (engine is a FleetRouter) ------------------------- #
+    def _fleet_replica(self, action: str, spec: FaultSpec):
+        """Resolve ``spec.replica`` against the router, or record why
+        the fault was skipped (single-engine soaks stay inert)."""
+        replicas = getattr(self.engine, "replicas", None)
+        if replicas is None or not hasattr(self.engine, "kill"):
+            self._event(action, step=spec.step, skipped="not_a_fleet")
+            return None
+        idx = spec.replica if spec.replica is not None else 0
+        if not 0 <= idx < len(replicas):
+            self._event(action, step=spec.step, replica=idx,
+                        skipped="replica_out_of_range")
+            return None
+        return replicas[idx]
+
+    def _on_replica_kill(self, spec: FaultSpec) -> None:
+        rep = self._fleet_replica("replica_kill", spec)
+        if rep is None:
+            return
+        outcome = self.engine.kill(rep.name)
+        self._event(
+            "replica_kill", step=spec.step, replica=rep.name,
+            requeued=outcome["requeued"], lost=outcome["lost"],
+        )
+
+    def _on_replica_slow(self, spec: FaultSpec) -> None:
+        rep = self._fleet_replica("replica_slow", spec)
+        if rep is None:
+            return
+        secs = spec.stall_secs or DEFAULT_STALL_SECS
+        self.engine.slow(rep.name, secs)
+        self._event(
+            "replica_slow", step=spec.step, replica=rep.name, secs=secs
         )

@@ -540,6 +540,12 @@ class SoakHarness:
             "interrupted": self._interrupted,
             "stop_reason": self._stop_reason,
         }
+        # fleet soaks: the engine is a FleetRouter — surface its
+        # placement/re-route ledger (policy, per-replica routed counts,
+        # requeued vs lost) alongside the serving numbers
+        rsum = getattr(self.engine, "router_summary", None)
+        if rsum is not None:
+            report["router"] = rsum()
         self._emit_soak_final(report)
         if cfg.report_path:
             write_report(cfg.report_path, report)

@@ -1,0 +1,245 @@
+"""Replica handles: the router's view of one serving engine.
+
+Two transports, one duck-typed surface:
+
+* :class:`InProcessReplica` wraps a live ``ServingEngine`` object —
+  CPU tests and the virtual-clock soak drive a whole fleet in one
+  process, gauges read directly off the scheduler/pool (no HTTP, no
+  serialization);
+* :class:`HTTPReplica` is the metrics-plane client for real
+  deployments: it scrapes ``/debug/state`` for gauges, ``/healthz``
+  for liveness/draining, and ``/debug/prefix`` for the cached-chain
+  digest. It is a PLACEMENT client only — submission goes through
+  whatever ingress the deployment already has; the router's
+  :meth:`~accelerate_tpu.router.FleetRouter.select` returns the chosen
+  replica's name for the caller to dispatch on.
+
+Every fetch can fail (replica mid-restart, scrape racing a drain); the
+ROUTER owns staleness policy — handles just raise, and the router
+degrades to the last cached snapshot instead of wedging admission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class ReplicaSnapshot:
+    """One replica's load posture at ``taken_at`` (router clock). The
+    four gauges every placement policy consumes, nothing more — a
+    snapshot must stay cheap to fetch, serialize and cache."""
+
+    queue_depth: int = 0
+    slots_active: int = 0
+    slot_occupancy: float = 0.0
+    pool_utilization: float = 0.0
+    tokens_in_flight: int = 0
+    taken_at: float = 0.0
+    #: True when this is a cached snapshot served after a failed refresh
+    stale: bool = False
+
+    @classmethod
+    def from_gauges(cls, gauges: dict, taken_at: float) -> "ReplicaSnapshot":
+        return cls(
+            queue_depth=int(gauges.get("queue_depth") or 0),
+            slots_active=int(gauges.get("slots_active") or 0),
+            slot_occupancy=float(gauges.get("slot_occupancy") or 0.0),
+            pool_utilization=float(gauges.get("pool_utilization") or 0.0),
+            tokens_in_flight=int(gauges.get("tokens_in_flight") or 0),
+            taken_at=taken_at,
+        )
+
+
+class InProcessReplica:
+    """A ``ServingEngine`` held in this process. The engine is
+    duck-typed exactly like the soak harness's: ``add_request`` /
+    ``step`` / ``has_work`` required, everything else getattr-guarded —
+    the fake engines the router unit tests run on a fake clock need no
+    jax."""
+
+    def __init__(self, name: str, engine: Any):
+        self.name = name
+        self.engine = engine
+        self._dead = False
+
+    # -- lifecycle ----------------------------------------------------- #
+    @property
+    def alive(self) -> bool:
+        return not self._dead
+
+    def mark_dead(self) -> None:
+        """A ``replica_kill`` landed: the handle stays registered (its
+        trace counts and stats still merge into fleet totals) but takes
+        no traffic and no steps."""
+        self._dead = True
+
+    def health(self) -> dict:
+        if self._dead:
+            return {"ok": False, "state": "dead"}
+        fn = getattr(self.engine, "health", None)
+        if fn is not None:
+            return dict(fn())
+        return {"ok": True, "state": "serving"}
+
+    @property
+    def draining(self) -> bool:
+        return bool(getattr(self.engine, "draining", False))
+
+    def drain(self) -> list:
+        """Stop this replica's admission and harvest its unadmitted
+        queue (the router re-routes the harvest). In-flight seats keep
+        decoding to completion — rotation without shedding."""
+        fn = getattr(self.engine, "drain", None)
+        return list(fn()) if fn is not None else []
+
+    # -- serving surface ----------------------------------------------- #
+    def add_request(self, prompt, **kwargs) -> str:
+        return self.engine.add_request(prompt, **kwargs)
+
+    def step(self):
+        return self.engine.step()
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.engine.has_work) and not self._dead
+
+    def result(self, request_id: str):
+        fn = getattr(self.engine, "result", None)
+        return fn(request_id) if fn is not None else None
+
+    def shed_reason(self, request_id: str):
+        fn = getattr(self.engine, "shed_reason", None)
+        return fn(request_id) if fn is not None else None
+
+    # -- placement inputs ---------------------------------------------- #
+    def fetch_snapshot(self, now: float) -> ReplicaSnapshot:
+        gauges_fn = getattr(self.engine, "_gauge_fields", None)
+        if gauges_fn is None:
+            return ReplicaSnapshot(taken_at=now)
+        return ReplicaSnapshot.from_gauges(gauges_fn(), now)
+
+    def fetch_digest(self, max_entries: int) -> dict:
+        fn = getattr(self.engine, "prefix_digest", None)
+        if fn is None:
+            return {"entries": [], "block_size": 0, "fingerprint": ""}
+        return fn(max_entries)
+
+    def queued_requests(self) -> list:
+        """The unadmitted queue entries (``Request`` objects) — what a
+        kill-time ejection can still save. Seated requests' KV lives on
+        the dead device; they are LOST, and counted as such."""
+        sched = getattr(self.engine, "scheduler", None)
+        if sched is None:
+            return []
+        out = list(sched.queue)
+        sched.queue.clear()
+        return out
+
+    def seated_count(self) -> int:
+        sched = getattr(self.engine, "scheduler", None)
+        if sched is None:
+            return 0
+        n = sum(1 for s in sched.slots if s.busy)
+        return n + len(getattr(self.engine, "_swapped_reqs", ()))
+
+
+class HTTPReplica:
+    """Metrics-plane client against a replica's scrape endpoint (the
+    PR 8 ``MetricsHTTPExporter``). Stdlib ``urllib`` only; every call
+    has a bounded timeout and raises on failure — staleness tolerance
+    is the router's job, not this client's."""
+
+    def __init__(self, name: str, base_url: str, timeout_s: float = 1.0):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._dead = False
+
+    @property
+    def alive(self) -> bool:
+        return not self._dead
+
+    def mark_dead(self) -> None:
+        self._dead = True
+
+    def _get_json(self, path: str) -> Any:
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                self.base_url + path, timeout=self.timeout_s
+            ) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            # /healthz serves its JSON body on 503 too (draining/dead
+            # posture is data, not an error)
+            if path == "/healthz":
+                try:
+                    return json.loads(exc.read().decode())
+                except Exception:
+                    pass
+            raise
+
+    def health(self) -> dict:
+        if self._dead:
+            return {"ok": False, "state": "dead"}
+        body = self._get_json("/healthz")
+        if not isinstance(body, dict):
+            return {"ok": bool(body), "state": "serving"}
+        body.setdefault("state", "serving" if body.get("ok") else "down")
+        return body
+
+    @property
+    def draining(self) -> bool:
+        try:
+            return self.health().get("state") == "draining"
+        except Exception:
+            return False
+
+    def fetch_snapshot(self, now: float) -> ReplicaSnapshot:
+        state = self._get_json("/debug/state")
+        gauges = state.get("gauges") or {} if isinstance(state, dict) else {}
+        return ReplicaSnapshot.from_gauges(gauges, now)
+
+    def fetch_digest(self, max_entries: int) -> dict:
+        return self._get_json("/debug/prefix")
+
+    # -- placement-only client: no in-band submission ------------------- #
+    def add_request(self, prompt, **kwargs) -> str:
+        raise NotImplementedError(
+            "HTTPReplica is a metrics-plane placement client; submit via "
+            "the replica's own ingress (use FleetRouter.select to pick it)"
+        )
+
+    def step(self):
+        return []
+
+    @property
+    def has_work(self) -> bool:
+        return False
+
+    def result(self, request_id: str):
+        return None
+
+    def shed_reason(self, request_id: str):
+        return None
+
+    def drain(self) -> list:
+        return []
+
+    def queued_requests(self) -> list:
+        return []
+
+    def seated_count(self) -> int:
+        return 0
+
+    def engine_attr(self, name: str, default=None):
+        return default
+
+    @property
+    def engine(self) -> Optional[Any]:
+        return None

@@ -1,0 +1,487 @@
+"""The fleet router: lifecycle, placement, and re-route accounting.
+
+:class:`FleetRouter` fronts N replicas and presents the soak harness's
+duck-typed engine surface (``add_request`` / ``step`` / ``has_work`` /
+``result`` / ``trace_counts`` / ``stats`` / ``set_observability``), so
+the PR 16 harness drives a fleet exactly as it drives one engine — one
+router step steps every live replica once.
+
+Placement is snapshot-driven and never blocks: gauges are cached per
+replica with a max age, a failed refresh serves the last known
+snapshot marked stale (``stale_snapshot_routes_total`` counts how
+often), and a replica with NO snapshot yet routes on an optimistic
+zero-load default. Admission can therefore mis-place under stale data
+— that is the designed trade; it can never wedge.
+
+Lifecycle:
+
+* :meth:`register` / :meth:`remove` — add/drop a replica;
+* :meth:`drain` — stop the replica's admission (its ``/healthz`` turns
+  ``draining``), re-route its unadmitted queue to the rest of the
+  fleet (counted in ``rerouted_total`` / ``requests_requeued``), let
+  seated work finish — rotation without shedding;
+* :meth:`kill` — a crash/`replica_kill` chaos action: the unadmitted
+  queue is re-queued onto survivors, seated requests are LOST (their
+  KV died with the replica) and counted in ``requests_lost``;
+* health-driven ejection: every :meth:`step` polls ``health()`` and a
+  replica that stops reporting ok is ejected through the same path as
+  :meth:`kill`.
+
+Session affinity (``session_affinity=True``) pins ``session_id`` →
+replica in a bounded LRU map; a pinned replica that drains or dies
+spills the session to the base policy (``session_spills_total``).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Iterable, Optional
+
+from .policies import Candidate, make_policy
+from .replica import ReplicaSnapshot
+
+
+class _FleetStats:
+    """The slice of ``ServeStats`` the soak harness reads off its
+    engine, merged across replicas on demand."""
+
+    def __init__(self, router: "FleetRouter"):
+        self._router = router
+
+    @property
+    def shed_counts(self) -> dict:
+        merged: dict[str, int] = {}
+        for rep in self._router._all_replicas():
+            stats = getattr(rep.engine, "stats", None)
+            counts = getattr(stats, "shed_counts", None)
+            if counts:
+                for reason, n in counts.items():
+                    merged[reason] = merged.get(reason, 0) + n
+        return merged
+
+
+class FleetRouter:
+    """Host-side multi-replica router (see module docstring).
+
+    ``policy``: ``"round_robin"`` | ``"least_loaded"`` |
+    ``"prefix_affinity"`` or a policy instance. ``now`` must be the
+    same injectable clock the replicas' engines stamp from (the soak
+    harness's virtual clock in tests/benches, ``time.monotonic`` in
+    production).
+    """
+
+    def __init__(
+        self,
+        replicas: Iterable = (),
+        *,
+        policy="least_loaded",
+        load_penalty: Optional[float] = None,
+        session_affinity: bool = False,
+        max_sessions: int = 4096,
+        snapshot_max_age_s: float = 0.0,
+        digest_max_age_s: float = 0.05,
+        digest_max_entries: int = 512,
+        now: Callable[[], float] = time.monotonic,
+    ):
+        if max_sessions < 1:
+            raise ValueError("max_sessions must be >= 1")
+        self.policy = make_policy(policy, load_penalty=load_penalty)
+        self.session_affinity = session_affinity
+        self.max_sessions = max_sessions
+        self.snapshot_max_age_s = snapshot_max_age_s
+        self.digest_max_age_s = digest_max_age_s
+        self.digest_max_entries = digest_max_entries
+        self._now = now
+        self._replicas: "OrderedDict[str, Any]" = OrderedDict()
+        self._order: dict[str, int] = {}
+        self._next_order = 0
+        self._snaps: dict[str, ReplicaSnapshot] = {}
+        self._digests: dict[str, dict] = {}  # name -> {keys,set, meta, at}
+        self._sessions: "OrderedDict[str, str]" = OrderedDict()
+        # bounded rid -> replica-name map for result()/shed_reason()
+        self._placements: "OrderedDict[str, str]" = OrderedDict()
+        self._max_placements = 65536
+        self._slow_until: dict[str, float] = {}
+        # accounting (the soak report's router section)
+        self.routed_total = 0
+        self.routed_by_replica: dict[str, int] = {}
+        self.rerouted_total = 0
+        self.requests_requeued = 0
+        self.requests_lost = 0
+        self.session_spills_total = 0
+        self.stale_snapshot_routes_total = 0
+        self.ejections_total = 0
+        self.stats = _FleetStats(self)
+        for rep in replicas:
+            self.register(rep)
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    def register(self, replica) -> None:
+        if replica.name in self._replicas:
+            raise ValueError(f"replica {replica.name!r} already registered")
+        self._replicas[replica.name] = replica
+        self._order[replica.name] = self._next_order
+        self._next_order += 1
+        self.routed_by_replica.setdefault(replica.name, 0)
+
+    @property
+    def replicas(self) -> list:
+        return list(self._replicas.values())
+
+    def replica(self, name: str):
+        return self._replicas[name]
+
+    def drain(self, name: str) -> dict:
+        """Graceful rotation: stop ``name``'s admission and re-route
+        its unadmitted queue onto the rest of the fleet. Returns the
+        re-route accounting for this drain."""
+        rep = self._replicas[name]
+        harvested = rep.drain()
+        requeued = self._requeue(harvested, exclude=name)
+        return {"replica": name, "requeued": requeued, "lost": 0}
+
+    def kill(self, name: str) -> dict:
+        """Ungraceful loss (crash / ``replica_kill`` chaos): re-queue
+        what never reached a seat, count what died with the replica."""
+        rep = self._replicas[name]
+        if not rep.alive:
+            return {"replica": name, "requeued": 0, "lost": 0}
+        harvested = rep.queued_requests()
+        seated_lost = rep.seated_count()
+        rep.mark_dead()
+        self.ejections_total += 1
+        requeued = self._requeue(harvested, exclude=name)
+        self.requests_lost += seated_lost
+        # lost = seats that died with the replica + harvested entries
+        # no survivor could take (those are already in requests_lost
+        # via the _requeue failure path)
+        lost = seated_lost + (len(harvested) - requeued)
+        return {"replica": name, "requeued": requeued, "lost": lost}
+
+    def remove(self, name: str) -> None:
+        """Unregister a replica (drain it first for a graceful exit —
+        remove does not harvest)."""
+        self._replicas.pop(name)
+        self._order.pop(name, None)
+        self._snaps.pop(name, None)
+        self._digests.pop(name, None)
+        self._slow_until.pop(name, None)
+
+    def slow(self, name: str, secs: float) -> None:
+        """``replica_slow`` chaos: the replica takes no steps until
+        ``now + secs`` — queued work piles up on it, and load-aware
+        policies route around it."""
+        self._slow_until[name] = self._now() + max(0.0, secs)
+
+    def _eject_unhealthy(self) -> None:
+        for name, rep in list(self._replicas.items()):
+            if not rep.alive:
+                continue
+            try:
+                ok = bool(rep.health().get("ok"))
+            except Exception:
+                ok = False
+            if not ok:
+                self.kill(name)
+
+    def _requeue(self, requests, exclude: Optional[str] = None) -> int:
+        n = 0
+        for req in requests:
+            try:
+                self.add_request(
+                    list(req.prompt),
+                    max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature,
+                    eos_token_id=req.eos_token_id,
+                    request_id=req.request_id,
+                    adapter=req.adapter,
+                    priority=req.priority,
+                    _exclude=exclude,
+                )
+                n += 1
+            except RuntimeError:
+                # nowhere left to put it: the request is lost, not
+                # silently dropped
+                self.requests_lost += 1
+        self.rerouted_total += n
+        self.requests_requeued += n
+        return n
+
+    # ------------------------------------------------------------------ #
+    # placement
+    # ------------------------------------------------------------------ #
+    def _routable(self, exclude: Optional[str] = None) -> list:
+        return [
+            r for name, r in self._replicas.items()
+            if r.alive and not r.draining and name != exclude
+        ]
+
+    def _snapshot(self, rep) -> ReplicaSnapshot:
+        now = self._now()
+        cached = self._snaps.get(rep.name)
+        if (
+            cached is not None
+            and not cached.stale
+            and now - cached.taken_at < self.snapshot_max_age_s
+        ):
+            # strict <: the 0.0 default means "always refetch", which
+            # is right for in-process replicas where a fetch is a dict
+            # read; HTTP fleets set a real tolerance to bound scrapes
+            return cached
+        try:
+            snap = rep.fetch_snapshot(now)
+        except Exception:
+            # staleness tolerance: a dead scrape must never wedge
+            # admission — serve the last known posture (or an
+            # optimistic zero-load default) and count it
+            self.stale_snapshot_routes_total += 1
+            snap = cached or ReplicaSnapshot(taken_at=now)
+            snap.stale = True
+        self._snaps[rep.name] = snap
+        return snap
+
+    def _digest(self, rep) -> Optional[dict]:
+        now = self._now()
+        cached = self._digests.get(rep.name)
+        if cached is not None and now - cached["at"] <= self.digest_max_age_s:
+            return cached
+        try:
+            raw = rep.fetch_digest(self.digest_max_entries)
+        except Exception:
+            return cached  # stale digest beats no digest
+        entry = {
+            "at": now,
+            "keys": set(raw.get("entries") or ()),
+            "block_size": int(raw.get("block_size") or 0),
+            "fingerprint": raw.get("fingerprint") or "",
+        }
+        self._digests[rep.name] = entry
+        return entry
+
+    def _overlap_tokens(self, rep, prompt, adapter) -> int:
+        digest = self._digest(rep)
+        if not digest or not digest["keys"] or not digest["block_size"]:
+            return 0
+        from ..serving.block_pool import prefix_keys
+
+        block_size = digest["block_size"]
+        keys = prefix_keys(digest["fingerprint"], adapter, prompt, block_size)
+        n = 0
+        for k in keys:
+            if k.hex() not in digest["keys"]:
+                break
+            n += 1
+        # the admission tail always keeps >= 1 prompt token, so a
+        # full-prompt chain is worth at most len(prompt) - 1 cached
+        # tokens on the replica — mirror that here
+        return min(n * block_size, max(len(prompt) - 1, 0))
+
+    def select(
+        self,
+        prompt,
+        adapter: Optional[str] = None,
+        session_id: Optional[str] = None,
+        _exclude: Optional[str] = None,
+    ) -> str:
+        """Pick a replica name for this request (placement only — the
+        deployment's ingress does the submission when replicas are
+        HTTP handles). Raises ``RuntimeError`` when no live,
+        non-draining replica exists."""
+        routable = self._routable(exclude=_exclude)
+        if not routable:
+            raise RuntimeError("no live non-draining replica to route to")
+        if self.session_affinity and session_id is not None:
+            pinned = self._sessions.get(session_id)
+            if pinned is not None:
+                rep = self._replicas.get(pinned)
+                if (
+                    rep is not None and rep.alive and not rep.draining
+                    and pinned != _exclude
+                ):
+                    self._sessions.move_to_end(session_id)
+                    return pinned
+                # pinned replica shed/drained/died: graceful spill
+                self.session_spills_total += 1
+        cands = []
+        for rep in routable:
+            snap = self._snapshot(rep)
+            overlap = (
+                self._overlap_tokens(rep, prompt, adapter)
+                if getattr(self.policy, "needs_overlap", False) else 0
+            )
+            cands.append(
+                Candidate(
+                    name=rep.name, order=self._order[rep.name],
+                    snapshot=snap, overlap_tokens=overlap,
+                )
+            )
+        choice = self.policy.choose(cands).name
+        if self.session_affinity and session_id is not None:
+            self._sessions[session_id] = choice
+            self._sessions.move_to_end(session_id)
+            while len(self._sessions) > self.max_sessions:
+                self._sessions.popitem(last=False)
+        return choice
+
+    # ------------------------------------------------------------------ #
+    # the harness-facing engine surface
+    # ------------------------------------------------------------------ #
+    def add_request(
+        self,
+        prompt,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        eos_token_id: Optional[int] = None,
+        request_id: str = "",
+        adapter: Optional[str] = None,
+        priority: int = 0,
+        session_id: Optional[str] = None,
+        _exclude: Optional[str] = None,
+    ) -> str:
+        name = self.select(
+            prompt, adapter=adapter, session_id=session_id,
+            _exclude=_exclude,
+        )
+        rid = self._replicas[name].add_request(
+            prompt,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            eos_token_id=eos_token_id,
+            request_id=request_id,
+            adapter=adapter,
+            priority=priority,
+        )
+        self.routed_total += 1
+        self.routed_by_replica[name] = self.routed_by_replica.get(name, 0) + 1
+        self._placements[rid] = name
+        while len(self._placements) > self._max_placements:
+            self._placements.popitem(last=False)
+        return rid
+
+    def step(self) -> list:
+        """One fleet iteration: eject replicas whose health went bad
+        (re-queueing what can be saved), then step every live replica
+        that is not chaos-slowed. Returns the merged token events."""
+        self._eject_unhealthy()
+        now = self._now()
+        events: list = []
+        for name, rep in self._replicas.items():
+            if not rep.alive:
+                continue
+            until = self._slow_until.get(name)
+            if until is not None:
+                if now < until:
+                    continue
+                del self._slow_until[name]
+            if rep.has_work:
+                out = rep.step()
+                if out:
+                    events.extend(out)
+        return events
+
+    @property
+    def has_work(self) -> bool:
+        return any(r.alive and r.has_work for r in self._replicas.values())
+
+    def result(self, request_id: str):
+        name = self._placements.get(request_id)
+        if name is not None and name in self._replicas:
+            return self._replicas[name].result(request_id)
+        for rep in self._replicas.values():
+            out = rep.result(request_id)
+            if out is not None:
+                return out
+        return None
+
+    def shed_reason(self, request_id: str):
+        name = self._placements.get(request_id)
+        if name is not None and name in self._replicas:
+            return self._replicas[name].shed_reason(request_id)
+        for rep in self._replicas.values():
+            out = rep.shed_reason(request_id)
+            if out is not None:
+                return out
+        return None
+
+    def trace_counts(self) -> dict:
+        """Fleet-merged compiled-program counts. Dead replicas keep
+        contributing their final counts — a kill must never make the
+        zero-retrace delta go negative."""
+        merged: dict[str, int] = {}
+        for rep in self._all_replicas():
+            fn = getattr(rep.engine, "trace_counts", None)
+            if fn is None:
+                continue
+            for prog, n in fn().items():
+                merged[prog] = merged.get(prog, 0) + n
+        return merged
+
+    def set_observability(
+        self,
+        *,
+        telemetry: Any = None,
+        gauge_interval: int = 1,
+        slo: Any = None,
+        spans: bool = True,
+    ) -> None:
+        """Attach ONE observability plane to the whole fleet: every
+        replica engine tees into the same collector and the same
+        :class:`~accelerate_tpu.serving.SloTracker` (fleet-level SLO
+        attainment — a burn on any replica is a burn on the fleet)."""
+        tracker = None
+        if slo is not None:
+            from ..serving.slo import SloTracker
+
+            tracker = slo if isinstance(slo, SloTracker) else SloTracker(slo)
+        self.slo_tracker = tracker
+        for rep in self._all_replicas():
+            setter = getattr(rep.engine, "set_observability", None)
+            if setter is not None:
+                setter(
+                    telemetry=telemetry, gauge_interval=gauge_interval,
+                    slo=tracker, spans=spans,
+                )
+
+    slo_tracker: Any = None
+
+    def _all_replicas(self):
+        return self._replicas.values()
+
+    # ------------------------------------------------------------------ #
+    # reporting
+    # ------------------------------------------------------------------ #
+    def router_summary(self) -> dict:
+        """The soak report's ``router`` section: placement policy,
+        per-replica posture, and the re-route ledger (what a kill or
+        drain re-queued vs lost)."""
+        reps = []
+        for name, rep in self._replicas.items():
+            try:
+                state = rep.health().get("state", "serving")
+            except Exception:
+                state = "unreachable"
+            reps.append({
+                "name": name,
+                "state": state,
+                "routed": self.routed_by_replica.get(name, 0),
+            })
+        return {
+            "policy": getattr(self.policy, "name", type(self.policy).__name__),
+            "session_affinity": self.session_affinity,
+            "replicas_total": len(self._replicas),
+            "replicas_alive": sum(
+                1 for r in self._replicas.values() if r.alive
+            ),
+            "replicas": reps,
+            "routed_total": self.routed_total,
+            "rerouted_total": self.rerouted_total,
+            "requests_requeued": self.requests_requeued,
+            "requests_lost": self.requests_lost,
+            "ejections_total": self.ejections_total,
+            "session_spills_total": self.session_spills_total,
+            "sessions_tracked": len(self._sessions),
+            "stale_snapshot_routes_total": self.stale_snapshot_routes_total,
+        }

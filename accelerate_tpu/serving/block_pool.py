@@ -326,6 +326,45 @@ class BlockPool:
         for block in list(self._hash_of):
             self.unpublish(block)
 
+    def cached_chain_digest(self, max_entries: int = 512) -> dict:
+        """A bounded digest of this pool's content index for router-side
+        prefix-affinity scoring.
+
+        Entries are the chain keys themselves (hex) — rolling sha256
+        hashes already scoped to (model fingerprint, tenant adapter,
+        full token prefix), so the digest exposes no raw tokens and a
+        key can only match a request from the same tenant with the same
+        prefix. Live (allocated, published) keys come first — they are
+        the prefixes most likely still warm — then cached-LRU keys from
+        most- to least-recently used, truncated at ``max_entries``.
+        """
+        if max_entries < 0:
+            raise ValueError("max_entries must be >= 0")
+        entries: list[str] = []
+        seen: set[int] = set()
+        for block, key in self._hash_of.items():
+            if len(entries) >= max_entries:
+                break
+            if block in self._ref:
+                entries.append(key.hex())
+                seen.add(block)
+        # MRU end of the LRU first: under truncation the digest keeps
+        # the prefixes most likely to survive eviction until the scrape
+        for block in reversed(self._lru):
+            if len(entries) >= max_entries:
+                break
+            if block in seen:
+                continue
+            key = self._hash_of.get(block)
+            if key is not None:
+                entries.append(key.hex())
+        return {
+            "block_size": self.block_size,
+            "entries": entries,
+            "total": len(self._index),
+            "truncated": len(self._index) > len(entries),
+        }
+
     def stats(self) -> dict:
         """Occupancy snapshot; ``utilization`` counts only allocatable
         blocks (the garbage block is overhead, not capacity)."""

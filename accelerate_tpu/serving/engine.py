@@ -1795,13 +1795,52 @@ class ServingEngine:
         spans = list(self.span_log.closed) + self.span_log.open_spans
         return write_chrome_trace(path, spans)
 
+    def drain(self) -> list:
+        """Enter drain mode: admission stops (``/healthz`` reports
+        ``draining``, new submits shed with reason ``"draining"``),
+        seated requests keep decoding to completion, and the unadmitted
+        queue is harvested and RETURNED for the caller (typically a
+        :class:`~accelerate_tpu.router.FleetRouter`) to re-route —
+        graceful replica rotation without losing queued work."""
+        self.scheduler.draining = True
+        return self.scheduler.harvest_queue()
+
+    def undrain(self) -> None:
+        """Leave drain mode: admission resumes."""
+        self.scheduler.draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self.scheduler.draining
+
+    def health(self) -> dict:
+        """The ``/healthz`` body: ``ok`` stays true while draining (the
+        process is healthy — it is just not taking traffic), and the
+        ``state`` field is what routers key ejection/rotation off."""
+        return {
+            "ok": True,
+            "state": "draining" if self.scheduler.draining else "serving",
+        }
+
+    def prefix_digest(self, max_entries: int = 512) -> dict:
+        """The ``/debug/prefix`` body: a bounded digest of this
+        replica's cached chain keys for router-side overlap scoring.
+        Keys are the PR 13 rolling hashes — tenant-fingerprint-scoped
+        and content-addressed, so the digest never exposes raw tokens
+        and never matches across tenants/adapters."""
+        digest = self.pool.cached_chain_digest(max_entries)
+        digest["fingerprint"] = self._model_fingerprint
+        digest["enabled"] = self.prefix_cache is not None
+        return digest
+
     def start_http(self, port: int = 0, host: str = "127.0.0.1"):
         """Start the stdlib scrape endpoint (``/metrics`` Prometheus
-        text, ``/healthz``, ``/debug/state`` = :meth:`summary` JSON) on
-        a background thread; returns the exporter (``.port`` carries the
-        bound port when ``port=0``). Requires an attached telemetry with
-        a :class:`~..telemetry.sinks.PrometheusTextSink` for /metrics —
-        one is added in-memory if missing."""
+        text, ``/healthz`` = :meth:`health` JSON, ``/debug/state`` =
+        :meth:`summary` JSON, ``/debug/prefix`` = :meth:`prefix_digest`)
+        on a background thread; returns the exporter (``.port`` carries
+        the bound port when ``port=0``). Requires an attached telemetry
+        with a :class:`~..telemetry.sinks.PrometheusTextSink` for
+        /metrics — one is added in-memory if missing."""
         if self._http is not None:
             return self._http
         from ..telemetry.http_exporter import MetricsHTTPExporter
@@ -1821,6 +1860,7 @@ class ServingEngine:
                 metrics_fn = prom.render
         self._http = MetricsHTTPExporter(
             metrics_fn=metrics_fn, state_fn=self.summary,
+            health_fn=self.health, prefix_fn=self.prefix_digest,
             host=host, port=port,
         )
         self._http.start()

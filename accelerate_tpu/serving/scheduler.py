@@ -212,6 +212,10 @@ class ContinuousScheduler:
         # the queue then stops being submit-ordered and shed_expired
         # must scan past the head
         self._saw_priority = False
+        # drain mode (set via ServingEngine.drain): admission stops,
+        # new submits are refused with shed_reason="draining", seated
+        # work finishes — the router's graceful-rotation state
+        self.draining = False
         self.shed_counts = {"queue_full": 0, "queue_deadline": 0}
         self.blocked_reasons = {
             "no_free_slot": 0,
@@ -233,6 +237,15 @@ class ContinuousScheduler:
                 f"{self.pool.num_blocks - 1} allocatable blocks total"
             )
         request.submit_time = self._now()
+        if self.draining:
+            # a draining replica takes no new work; the refusal is a
+            # shed (terminal, observable) so callers without a router
+            # still see a definite outcome rather than a silent drop
+            request.shed_reason = "draining"
+            self.shed_counts["draining"] = (
+                self.shed_counts.get("draining", 0) + 1
+            )
+            return request.request_id
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
             # tail-drop: the newest request is the one refused (FIFO
             # fairness — those already waiting keep their place)
@@ -287,6 +300,14 @@ class ContinuousScheduler:
             shed.append(req)
         return shed
 
+    def harvest_queue(self) -> list[Request]:
+        """Pop and return every still-queued (unadmitted) request. Used
+        by drain/kill paths whose CALLER re-routes the harvest — no
+        shed accounting here, because the requests are not lost."""
+        out = list(self.queue)
+        self.queue.clear()
+        return out
+
     def release(self, slot: Slot) -> None:
         """Return a finished slot's references and empty the seat — the
         very next :meth:`admit` can refill it (continuous batching's
@@ -314,6 +335,10 @@ class ContinuousScheduler:
         extra private block is reserved for the engine's copy-on-write
         of the final shared block.
         """
+        if self.draining:
+            # seats already filled keep decoding; nothing new admits
+            # (queued entries wait for harvest_queue or undrain)
+            return []
         admitted = []
         free_slots = (s for s in self.slots if not s.busy)
         while self.queue:

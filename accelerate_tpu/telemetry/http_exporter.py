@@ -8,10 +8,17 @@ serving three routes:
 * ``/metrics`` — Prometheus text exposition (``metrics_fn``, typically a
   :class:`~.sinks.PrometheusTextSink`'s ``render``) for a Prometheus
   scraper or a human with curl;
-* ``/healthz`` — liveness JSON for k8s probes (``health_fn`` optional:
-  return a falsy value to report 503, e.g. "engine thread died");
+* ``/healthz`` — liveness JSON for k8s probes and routers.
+  ``health_fn`` may return a plain truthy/falsy value (classic probe:
+  falsy → 503 with ``{"ok": false}``) or a dict body such as
+  ``{"ok": true, "state": "draining"}`` — the dict is served verbatim
+  with the status taken from its ``"ok"`` key, so a draining replica
+  can advertise its state while still reporting healthy;
 * ``/debug/state`` — full state JSON (``state_fn``, typically
-  ``ServingEngine.summary``) for incident forensics.
+  ``ServingEngine.summary``) for incident forensics;
+* ``/debug/prefix`` — the replica's bounded cached-chain-key digest
+  (``prefix_fn``, typically ``ServingEngine.prefix_digest``) for
+  prefix-affinity routing; 404 when no ``prefix_fn`` is wired.
 
 ``port=0`` binds an ephemeral port (tests; ``.port`` carries the real
 one after :meth:`start`). Callbacks run on the serving thread — they
@@ -41,16 +48,25 @@ class MetricsHTTPExporter:
         metrics_fn: Optional[Callable[[], str]] = None,
         state_fn: Optional[Callable[[], Any]] = None,
         health_fn: Optional[Callable[[], Any]] = None,
+        prefix_fn: Optional[Callable[[], Any]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
         self.metrics_fn = metrics_fn
         self.state_fn = state_fn
         self.health_fn = health_fn
+        self.prefix_fn = prefix_fn
         self.host = host
         self.port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # scrapes currently inside do_GET — stop() waits these out so a
+        # shutdown racing an active scrape finishes the response (200)
+        # instead of killing the socket under it (client-visible 500)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
 
     # ------------------------------------------------------------------ #
     def start(self) -> "MetricsHTTPExporter":
@@ -70,6 +86,18 @@ class MetricsHTTPExporter:
                 self.wfile.write(body)
 
             def do_GET(self):
+                with exporter._inflight_lock:
+                    exporter._inflight += 1
+                    exporter._idle.clear()
+                try:
+                    self._do_GET()
+                finally:
+                    with exporter._inflight_lock:
+                        exporter._inflight -= 1
+                        if exporter._inflight == 0:
+                            exporter._idle.set()
+
+            def _do_GET(self):
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/metrics":
@@ -84,15 +112,30 @@ class MetricsHTTPExporter:
                             text.encode(),
                         )
                     elif path == "/healthz":
-                        ok = (
+                        raw = (
                             exporter.health_fn()
                             if exporter.health_fn is not None
                             else True
                         )
-                        body = json.dumps({"ok": bool(ok)}).encode()
+                        if isinstance(raw, dict):
+                            payload = dict(raw)
+                            payload["ok"] = bool(payload.get("ok"))
+                        else:
+                            payload = {"ok": bool(raw)}
+                        body = json.dumps(payload).encode()
                         self._send(
-                            200 if ok else 503, "application/json", body
+                            200 if payload["ok"] else 503,
+                            "application/json",
+                            body,
                         )
+                    elif path == "/debug/prefix":
+                        if exporter.prefix_fn is None:
+                            self._send(404, "text/plain", b"not found\n")
+                        else:
+                            body = json.dumps(
+                                exporter.prefix_fn(), default=str
+                            ).encode()
+                            self._send(200, "application/json", body)
                     elif path == "/debug/state":
                         state = (
                             exporter.state_fn()
@@ -131,6 +174,10 @@ class MetricsHTTPExporter:
         if self._server is None:
             return
         self._server.shutdown()
+        # let any scrape already inside a handler write its response
+        # before the listening socket closes — stop() racing an active
+        # scrape must not turn that scrape into a 500/connection reset
+        self._idle.wait(timeout=2.0)
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5.0)

@@ -25,7 +25,13 @@ instead of the process: ``stall_decode`` (``secs=N`` wedges the decode
 loop for N seconds — arrivals keep queueing, which is exactly the
 coordinated-omission scenario the loadgen harness measures),
 ``pool_pressure`` (pins a slab of free KV blocks so admission feels a
-full pool) and ``adapter_churn`` (thrashes adapter-registry residency).
+full pool), ``adapter_churn`` (thrashes adapter-registry residency),
+and — fleet-scoped, only meaningful when the handler's engine is a
+:class:`~accelerate_tpu.router.FleetRouter` — ``replica_kill``
+(``replica=N`` marks fleet replica N dead: its unadmitted queue is
+re-routed, its seated requests are lost) and ``replica_slow``
+(``replica=N:secs=S`` freezes replica N's step loop for S virtual
+seconds so load-aware placement must route around it).
 These never touch signals or sleep: they dispatch to a handler the
 soak harness's :class:`~accelerate_tpu.loadgen.chaos.ChaosAdapter`
 installs via :meth:`FaultInjector.install_handler`, and are silently
@@ -61,17 +67,27 @@ FAULT_ENV = ENV_PREFIX + "FAULT_INJECT"
 #: serving-scoped actions: dispatched to an installed handler (the soak
 #: harness's ChaosAdapter), never to signals/sleeps — non-fatal by
 #: construction
-SERVING_ACTIONS = ("stall_decode", "pool_pressure", "adapter_churn")
+SERVING_ACTIONS = (
+    "stall_decode",
+    "pool_pressure",
+    "adapter_churn",
+    "replica_kill",
+    "replica_slow",
+)
 
 _ACTIONS = ("kill", "sigterm", "sigint", "hang", "dcn_stall") + SERVING_ACTIONS
 
 #: actions whose ``secs=`` field bounds a stall duration
-_TIMED_ACTIONS = ("dcn_stall", "stall_decode", "pool_pressure")
+_TIMED_ACTIONS = ("dcn_stall", "stall_decode", "pool_pressure", "replica_slow")
+
+#: actions whose ``replica=`` field targets one fleet replica by index
+_REPLICA_ACTIONS = ("replica_kill", "replica_slow")
 
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
-    """One parsed fault: ``action@step:rank=R:gen=G[:slice=S][:secs=N]``."""
+    """One parsed fault:
+    ``action@step:rank=R:gen=G[:slice=S][:secs=N][:replica=N]``."""
 
     action: str
     step: int
@@ -79,6 +95,7 @@ class FaultSpec:
     generation: int = 0
     fault_domain: Optional[int] = None  # ``slice=`` gate; None = rank gate
     stall_secs: float = 0.0  # ``secs=``; dcn_stall duration, 0 = forever
+    replica: Optional[int] = None  # ``replica=``; fleet replica index
 
     @classmethod
     def parse(cls, text: str) -> "FaultSpec":
@@ -90,7 +107,8 @@ class FaultSpec:
                 f"'action@step[:rank=R][:gen=G][:slice=S][:secs=N]' "
                 f"with action in {_ACTIONS}"
             )
-        fields = {"rank": 0, "gen": 0, "slice": None, "secs": 0.0}
+        fields = {"rank": 0, "gen": 0, "slice": None, "secs": 0.0,
+                  "replica": None}
         for part in filter(None, tail.split(":")):
             key, eq, val = part.partition("=")
             if key not in fields or eq != "=":
@@ -103,6 +121,11 @@ class FaultSpec:
                 f"bad fault spec {text!r}: secs= only applies to "
                 f"{'/'.join(_TIMED_ACTIONS)}"
             )
+        if fields["replica"] is not None and action not in _REPLICA_ACTIONS:
+            raise ValueError(
+                f"bad fault spec {text!r}: replica= only applies to "
+                f"{'/'.join(_REPLICA_ACTIONS)}"
+            )
         return cls(
             action=action,
             step=int(step),
@@ -110,6 +133,7 @@ class FaultSpec:
             generation=fields["gen"],
             fault_domain=fields["slice"],
             stall_secs=fields["secs"],
+            replica=fields["replica"],
         )
 
     def render(self) -> str:
@@ -118,6 +142,8 @@ class FaultSpec:
             out += f":slice={self.fault_domain}"
         if self.stall_secs:
             out += f":secs={self.stall_secs:g}"
+        if self.replica is not None:
+            out += f":replica={self.replica}"
         return out
 
 

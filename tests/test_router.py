@@ -1,0 +1,703 @@
+"""Fleet serving: the multi-replica router and its placement policies.
+
+The router is pure host policy, so most of this file runs on FAKE
+engines and a fake clock — placement decisions, staleness tolerance,
+session spill, kill/drain accounting and the chaos handlers are all
+exact, deterministic assertions with no jax in the loop. The real-engine
+tests at the bottom pin the engine-side satellites (drain semantics, the
+bounded chain-key digest, the scrape endpoint's draining/prefix routes
+and stop-during-scrape behavior) and one small end-to-end fleet: three
+live engines behind prefix-affinity routing, warm hits strictly better
+than round-robin, one compiled decode per replica.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from collections import deque
+from types import SimpleNamespace
+
+import pytest
+
+from accelerate_tpu.router import (
+    FleetRouter,
+    InProcessReplica,
+    ReplicaSnapshot,
+    load_score,
+    make_policy,
+)
+from accelerate_tpu.serving.block_pool import BlockPool, prefix_keys
+from accelerate_tpu.telemetry.http_exporter import MetricsHTTPExporter
+from accelerate_tpu.test_utils.fault_injection import (
+    FaultInjector,
+    FaultSpec,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def tick(self, dt: float = 1.0) -> None:
+        self.t += dt
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class FakeReq:
+    """Shape-compatible with scheduler.Request where the router's
+    re-queue path reads it."""
+
+    def __init__(self, prompt, rid, adapter=None, max_new_tokens=4):
+        self.prompt = list(prompt)
+        self.request_id = rid
+        self.adapter = adapter
+        self.max_new_tokens = max_new_tokens
+        self.temperature = 0.0
+        self.eos_token_id = None
+        self.priority = 0
+
+
+class FakeEngine:
+    """A no-jax engine exposing exactly the duck surface the replica
+    handle reads: one queued request completes per step, and completion
+    'publishes' the request's chain keys so prefix_digest reflects what
+    the replica has cached (real rolling-hash math via prefix_keys)."""
+
+    block_size = 4
+
+    def __init__(self, fingerprint="fake-fp", gauges=None):
+        self.scheduler = SimpleNamespace(queue=deque(), slots=[])
+        self._swapped_reqs = []
+        self.gauges = dict(gauges or {})
+        self.fingerprint = fingerprint
+        self.keys = set()
+        self.warm_hits = 0
+        self.finished = {}
+        self._draining = False
+        self._n = 0
+
+    def add_request(self, prompt, max_new_tokens=32, temperature=0.0,
+                    eos_token_id=None, request_id="", adapter=None,
+                    priority=0):
+        rid = request_id or f"fake-{self._n}"
+        self._n += 1
+        keys = prefix_keys(self.fingerprint, adapter, prompt, self.block_size)
+        if keys and keys[0].hex() in self.keys:
+            self.warm_hits += 1
+        self.scheduler.queue.append(
+            FakeReq(prompt, rid, adapter, max_new_tokens)
+        )
+        return rid
+
+    def step(self):
+        if self.scheduler.queue:
+            req = self.scheduler.queue.popleft()
+            for k in prefix_keys(
+                self.fingerprint, req.adapter, req.prompt, self.block_size
+            ):
+                self.keys.add(k.hex())
+            self.finished[req.request_id] = [1]
+        return []
+
+    @property
+    def has_work(self):
+        return bool(self.scheduler.queue)
+
+    def _gauge_fields(self):
+        g = {
+            "queue_depth": len(self.scheduler.queue),
+            "slots_active": 0,
+            "slot_occupancy": 0.0,
+            "pool_utilization": 0.0,
+            "tokens_in_flight": 0,
+        }
+        g.update(self.gauges)
+        return g
+
+    def prefix_digest(self, max_entries=512):
+        entries = sorted(self.keys)[:max_entries]
+        return {
+            "block_size": self.block_size,
+            "entries": entries,
+            "fingerprint": self.fingerprint,
+            "total": len(self.keys),
+            "truncated": len(self.keys) > len(entries),
+        }
+
+    def drain(self):
+        self._draining = True
+        out = list(self.scheduler.queue)
+        self.scheduler.queue.clear()
+        return out
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def health(self):
+        return {
+            "ok": True,
+            "state": "draining" if self._draining else "serving",
+        }
+
+    def result(self, rid):
+        return self.finished.get(rid)
+
+    def shed_reason(self, rid):
+        return None
+
+
+def _fleet(n=3, policy="least_loaded", clock=None, gauges=None, **kw):
+    clock = clock or FakeClock()
+    engines = [FakeEngine(gauges=(gauges or {}).get(i)) for i in range(n)]
+    reps = [InProcessReplica(f"r{i}", e) for i, e in enumerate(engines)]
+    router = FleetRouter(reps, policy=policy, now=clock, **kw)
+    return router, engines, clock
+
+
+def _drain_fleet(router, clock, budget=200):
+    for _ in range(budget):
+        if not router.has_work:
+            return
+        router.step()
+        clock.tick(0.1)
+    raise AssertionError("fleet did not drain")
+
+
+# ---------------------------------------------------------------------- #
+# placement policies
+# ---------------------------------------------------------------------- #
+def test_least_loaded_picks_idle_replica_under_skew():
+    router, engines, _ = _fleet(
+        3, gauges={0: {"queue_depth": 5}, 2: {"queue_depth": 3}}
+    )
+    for _ in range(4):
+        router.add_request([1, 2, 3])
+    # every request lands on the idle replica... which then carries its
+    # own queue into the next snapshot — after 4 sends r1 has depth 4,
+    # so the 5th prefers r2 (depth 3)
+    assert router.routed_by_replica["r1"] == 4
+    router.add_request([1, 2, 3])
+    assert router.routed_by_replica["r2"] == 1
+
+
+def test_make_policy_resolution_and_load_score():
+    assert make_policy("round_robin").name == "round_robin"
+    assert make_policy("prefix_affinity", load_penalty=2.0).load_penalty == 2.0
+    with pytest.raises(ValueError):
+        make_policy("power_of_two")  # not (yet) a policy
+    snap = ReplicaSnapshot(queue_depth=3, slots_active=2,
+                           pool_utilization=0.5)
+    assert load_score(snap) == 5.5
+
+
+def test_round_robin_cycles_registration_order():
+    router, _, _ = _fleet(3, policy="round_robin")
+    picks = [router.select([1, 2, 3]) for _ in range(6)]
+    assert picks == ["r0", "r1", "r2", "r0", "r1", "r2"]
+
+
+def test_round_robin_skips_dead_and_draining():
+    router, _, _ = _fleet(3, policy="round_robin")
+    router.drain("r1")
+    picks = [router.select([1]) for _ in range(4)]
+    assert picks == ["r0", "r2", "r0", "r2"]
+
+
+def _templated_trace(n_cohorts=4, per_cohort=6, prefix_blocks=2, bs=4):
+    # 4 cohorts over a 3-replica fleet: the cohort cycle and the RR
+    # cycle are coprime, so round-robin genuinely scatters each cohort
+    trace = []
+    for i in range(n_cohorts * per_cohort):
+        c = i % n_cohorts
+        prefix = [100 + c] * (prefix_blocks * bs)
+        trace.append(prefix + [200 + i, 201 + i, 1 + c])
+    return trace
+
+
+def test_prefix_affinity_beats_round_robin_on_warm_hits():
+    def run(policy):
+        router, engines, clock = _fleet(3, policy=policy)
+        for prompt in _templated_trace():
+            router.add_request(prompt)
+            _drain_fleet(router, clock)
+        return sum(e.warm_hits for e in engines)
+
+    rr, affinity = run("round_robin"), run("prefix_affinity")
+    # each cohort's chain lives on ONE replica under affinity: every
+    # request after the cohort's first is warm
+    assert affinity == 4 * 6 - 4
+    assert affinity > rr
+
+
+def test_prefix_affinity_degrades_to_least_loaded_when_cold():
+    router, engines, _ = _fleet(
+        3, policy="prefix_affinity", gauges={0: {"queue_depth": 9}}
+    )
+    assert router.select([7, 7, 7, 7]) == "r1"  # no overlap anywhere
+
+
+def test_affinity_load_penalty_overrides_overlap():
+    """A warm replica buried under queue must lose to an idle cold one
+    once the penalty outweighs the overlap."""
+    router, engines, clock = _fleet(
+        2, policy="prefix_affinity", load_penalty=8.0
+    )
+    prompt = [5] * 12
+    router.add_request(prompt)
+    _drain_fleet(router, clock)
+    assert router.select(prompt) == "r0"  # warm, idle: affinity wins
+    engines[0].gauges["queue_depth"] = 50  # 50*8 penalty >> 11 overlap
+    clock.tick(1.0)  # age out cached snapshot + digest
+    assert router.select(prompt) == "r1"
+
+
+# ---------------------------------------------------------------------- #
+# session affinity
+# ---------------------------------------------------------------------- #
+def test_session_affinity_pins_and_spills_on_drain():
+    router, _, _ = _fleet(3, session_affinity=True)
+    first = router.select([1, 2], session_id="alice")
+    assert all(
+        router.select([i], session_id="alice") == first for i in range(5)
+    )
+    router.drain(first)
+    second = router.select([9], session_id="alice")
+    assert second != first
+    assert router.session_spills_total == 1
+    # the spill RE-PINS: later requests stick to the new home
+    assert router.select([10], session_id="alice") == second
+    assert router.session_spills_total == 1
+
+
+def test_session_map_is_bounded():
+    router, _, _ = _fleet(2, session_affinity=True, max_sessions=8)
+    for i in range(50):
+        router.select([1], session_id=f"s{i}")
+    assert len(router._sessions) == 8
+    assert router.router_summary()["sessions_tracked"] == 8
+
+
+# ---------------------------------------------------------------------- #
+# staleness tolerance
+# ---------------------------------------------------------------------- #
+def test_stale_gauge_snapshots_never_wedge_admission():
+    router, engines, clock = _fleet(2)
+    router.add_request([1, 2, 3])  # healthy snapshot cached for both
+
+    def boom():
+        raise ConnectionError("scrape died")
+
+    engines[0]._gauge_fields = boom
+    engines[1]._gauge_fields = boom
+    clock.tick(1.0)  # age the cache out
+    for _ in range(3):
+        router.add_request([4, 5, 6])  # must not raise
+    assert router.stale_snapshot_routes_total >= 2
+    assert router.routed_total == 4
+
+
+def test_snapshotless_replica_routes_optimistically():
+    """A replica that has NEVER produced a snapshot still takes traffic
+    (zero-load default) instead of blocking the fleet."""
+    router, engines, _ = _fleet(1)
+
+    def boom():
+        raise ConnectionError("never scraped")
+
+    engines[0]._gauge_fields = boom
+    assert router.select([1, 2]) == "r0"
+    assert router.stale_snapshot_routes_total == 1
+
+
+def test_digest_fetch_failure_degrades_to_load_routing():
+    router, engines, _ = _fleet(2, policy="prefix_affinity")
+
+    def boom(_max):
+        raise ConnectionError("no digest")
+
+    for e in engines:
+        e.prefix_digest = boom
+    assert router.select([1, 2, 3, 4]) in ("r0", "r1")  # no raise
+
+
+# ---------------------------------------------------------------------- #
+# lifecycle: drain / kill / health ejection / slow
+# ---------------------------------------------------------------------- #
+def test_drain_requeues_unadmitted_onto_survivors():
+    router, engines, _ = _fleet(2)
+    for _ in range(3):
+        router.add_request([1, 2])
+    # least-loaded: r0, r1, then the tie goes to r0 again
+    assert router.routed_by_replica == {"r0": 2, "r1": 1}
+    out = router.drain("r0")
+    assert out == {"replica": "r0", "requeued": 2, "lost": 0}
+    assert not engines[0].scheduler.queue
+    assert len(engines[1].scheduler.queue) == 3
+    assert router.requests_requeued == 2
+    assert router.router_summary()["replicas_alive"] == 2  # draining != dead
+
+
+def test_kill_requeues_queue_and_counts_seated_as_lost():
+    router, engines, _ = _fleet(2)
+    victim = engines[0]
+    victim.scheduler.queue.extend(
+        FakeReq([1, 2, 3], f"q{i}") for i in range(3)
+    )
+    victim.scheduler.slots = [
+        SimpleNamespace(busy=True), SimpleNamespace(busy=True),
+        SimpleNamespace(busy=False),
+    ]
+    out = router.kill("r0")
+    assert out == {"replica": "r0", "requeued": 3, "lost": 2}
+    assert len(engines[1].scheduler.queue) == 3  # landed on the survivor
+    assert router.requests_lost == 2
+    assert router.rerouted_total == 3
+    summary = router.router_summary()
+    assert summary["replicas_alive"] == 1
+    assert summary["ejections_total"] == 1
+    # idempotent: a second kill must not double-count
+    assert router.kill("r0") == {"replica": "r0", "requeued": 0, "lost": 0}
+
+
+def test_kill_with_no_survivor_counts_queue_as_lost():
+    router, engines, _ = _fleet(1)
+    engines[0].scheduler.queue.append(FakeReq([1], "q0"))
+    out = router.kill("r0")
+    assert out["requeued"] == 0 and out["lost"] == 1
+    with pytest.raises(RuntimeError):
+        router.add_request([1, 2])
+
+
+def test_healthz_ejection_on_step():
+    router, engines, clock = _fleet(2)
+    engines[0].scheduler.queue.append(FakeReq([1, 2], "q0"))
+    engines[0].health = lambda: {"ok": False, "state": "dead"}
+    router.step()
+    assert router.router_summary()["replicas_alive"] == 1
+    assert not router.replica("r0").alive
+    assert router.requests_requeued == 1
+    while router.has_work:  # the rescued request finishes on r1
+        router.step()
+    assert router.result("q0") == [1]
+
+
+def test_replica_slow_skips_steps_until_deadline():
+    router, engines, clock = _fleet(2)
+    router.add_request([1, 2])  # -> r0 (tie-break)
+    router.slow("r0", 5.0)
+    router.step()
+    assert engines[0].scheduler.queue  # frozen: took no step
+    clock.tick(6.0)
+    router.step()
+    assert not engines[0].scheduler.queue  # thawed
+
+
+def test_trace_counts_merge_keeps_dead_replicas():
+    router, engines, _ = _fleet(2)
+    for e in engines:
+        e.trace_counts = lambda: {"decode": 1, "prefill": 2}
+    assert router.trace_counts() == {"decode": 2, "prefill": 4}
+    router.kill("r0")
+    assert router.trace_counts() == {"decode": 2, "prefill": 4}
+
+
+def test_result_resolves_through_placement_map():
+    router, engines, clock = _fleet(2)
+    rid = router.add_request([1, 2, 3], request_id="want-this")
+    _drain_fleet(router, clock)
+    assert rid == "want-this"
+    assert router.result(rid) == [1]
+    assert router.result("never-submitted") is None
+
+
+# ---------------------------------------------------------------------- #
+# fault grammar + chaos handlers
+# ---------------------------------------------------------------------- #
+def test_fault_spec_replica_field_round_trips():
+    spec = FaultSpec.parse("replica_kill@0:replica=1")
+    assert spec.action == "replica_kill" and spec.replica == 1
+    assert FaultSpec.parse(spec.render()) == spec
+    slow = FaultSpec.parse("replica_slow@2:replica=0:secs=3")
+    assert slow.stall_secs == 3.0 and slow.replica == 0
+    assert FaultSpec.parse(slow.render()) == slow
+
+
+def test_fault_spec_replica_field_rejected_elsewhere():
+    with pytest.raises(ValueError):
+        FaultSpec.parse("stall_decode@0:replica=1")
+    with pytest.raises(ValueError):
+        FaultSpec.parse("replica_kill@0:secs=2")  # kill is not timed
+
+
+def test_chaos_replica_kill_fires_against_fleet():
+    from accelerate_tpu.loadgen.chaos import ChaosAdapter
+
+    router, engines, clock = _fleet(2)
+    engines[1].scheduler.queue.append(FakeReq([1, 2], "q0"))
+    injector = FaultInjector([], rank=0, generation=0)
+    chaos = ChaosAdapter(router, injector, clock)
+    injector.specs = [FaultSpec.parse("replica_kill@0:replica=1")]
+    injector.maybe_fire(0)
+    assert router.router_summary()["replicas_alive"] == 1
+    (event,) = [e for e in chaos.events if e["action"] == "replica_kill"]
+    assert event["replica"] == "r1"
+    assert event["requeued"] == 1 and event["lost"] == 0
+
+
+def test_chaos_replica_slow_fires_against_fleet():
+    from accelerate_tpu.loadgen.chaos import ChaosAdapter
+
+    router, engines, clock = _fleet(2)
+    injector = FaultInjector([], rank=0, generation=0)
+    chaos = ChaosAdapter(router, injector, clock)
+    injector.specs = [FaultSpec.parse("replica_slow@0:replica=0:secs=4")]
+    injector.maybe_fire(0)
+    (event,) = chaos.events
+    assert event["action"] == "replica_slow"
+    assert event["replica"] == "r0" and event["secs"] == 4.0
+    router.add_request([1, 2])  # ties still place on r0...
+    router.step()
+    assert engines[0].scheduler.queue  # ...but r0 is frozen: no step
+    clock.tick(5.0)
+    router.step()
+    assert not engines[0].scheduler.queue
+
+
+def test_chaos_replica_actions_skip_single_engine():
+    from accelerate_tpu.loadgen.chaos import ChaosAdapter
+
+    clock = FakeClock()
+    engine = FakeEngine()
+    injector = FaultInjector([], rank=0, generation=0)
+    chaos = ChaosAdapter(engine, injector, clock)
+    injector.specs = [FaultSpec.parse("replica_kill@0:replica=0")]
+    injector.maybe_fire(0)
+    assert chaos.events[0]["skipped"] == "not_a_fleet"
+    assert engine.has_work is False  # untouched
+
+
+def test_chaos_replica_out_of_range_skips():
+    from accelerate_tpu.loadgen.chaos import ChaosAdapter
+
+    router, _, clock = _fleet(2)
+    injector = FaultInjector([], rank=0, generation=0)
+    chaos = ChaosAdapter(router, injector, clock)
+    injector.specs = [FaultSpec.parse("replica_kill@0:replica=7")]
+    injector.maybe_fire(0)
+    assert chaos.events[0]["skipped"] == "replica_out_of_range"
+    assert router.router_summary()["replicas_alive"] == 2
+
+
+# ---------------------------------------------------------------------- #
+# the chain-key digest (BlockPool, host-only)
+# ---------------------------------------------------------------------- #
+def test_cached_chain_digest_is_bounded_and_token_free():
+    pool = BlockPool(num_blocks=32, block_size=4)
+    keys = prefix_keys("fp", None, list(range(1, 41)), 4)  # 10 full blocks
+    blocks = pool.allocate(len(keys))
+    for b, k in zip(blocks, keys):
+        pool.publish(b, k)
+    digest = pool.cached_chain_digest(max_entries=4)
+    assert len(digest["entries"]) == 4
+    assert digest["total"] == 10 and digest["truncated"]
+    assert all(
+        isinstance(e, str) and len(e) == 64 and int(e, 16) >= 0
+        for e in digest["entries"]
+    )
+    full = pool.cached_chain_digest(max_entries=100)
+    assert len(full["entries"]) == 10 and not full["truncated"]
+    assert set(full["entries"]) == {k.hex() for k in keys}
+
+
+def test_cached_chain_digest_prefers_live_then_mru():
+    pool = BlockPool(num_blocks=32, block_size=4)
+    keys = prefix_keys("fp", None, list(range(1, 25)), 4)  # 6 blocks
+    blocks = pool.allocate(len(keys))
+    for b, k in zip(blocks, keys):
+        pool.publish(b, k)
+    pool.free(blocks[3:])  # retire 3 chains into the cached LRU
+    digest = pool.cached_chain_digest(max_entries=3)
+    assert digest["entries"] == [k.hex() for k in keys[:3]]  # live first
+
+
+# ---------------------------------------------------------------------- #
+# scrape endpoint: dict healthz, /debug/prefix, stop-during-scrape
+# ---------------------------------------------------------------------- #
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read().decode())
+
+
+def test_healthz_serves_dict_body_with_state():
+    state = {"ok": True, "state": "serving"}
+    exporter = MetricsHTTPExporter(health_fn=lambda: dict(state)).start()
+    try:
+        code, body = _get(exporter.url + "/healthz")
+        assert (code, body) == (200, {"ok": True, "state": "serving"})
+        state["state"] = "draining"
+        code, body = _get(exporter.url + "/healthz")
+        assert (code, body) == (200, {"ok": True, "state": "draining"})
+        state.update(ok=False, state="dead")
+        code, body = _get(exporter.url + "/healthz")
+        assert (code, body) == (503, {"ok": False, "state": "dead"})
+    finally:
+        exporter.stop()
+
+
+def test_debug_prefix_route():
+    digest = {"block_size": 4, "entries": ["ab" * 32], "total": 1,
+              "truncated": False}
+    exporter = MetricsHTTPExporter(prefix_fn=lambda: digest).start()
+    try:
+        code, body = _get(exporter.url + "/debug/prefix")
+        assert code == 200 and body == digest
+    finally:
+        exporter.stop()
+    bare = MetricsHTTPExporter().start()
+    try:
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bare.url + "/debug/prefix", timeout=5)
+    finally:
+        bare.stop()
+
+
+def test_stop_during_active_scrape_completes_the_scrape():
+    entered, release = threading.Event(), threading.Event()
+
+    def slow_state():
+        entered.set()
+        assert release.wait(5.0)
+        return {"fine": True}
+
+    exporter = MetricsHTTPExporter(state_fn=slow_state).start()
+    results = []
+    scraper = threading.Thread(
+        target=lambda: results.append(_get(exporter.url + "/debug/state"))
+    )
+    scraper.start()
+    assert entered.wait(5.0)
+    stopper = threading.Thread(target=exporter.stop)
+    stopper.start()
+    release.set()  # let the in-flight handler finish under stop()
+    scraper.join(timeout=10.0)
+    stopper.join(timeout=10.0)
+    assert results == [(200, {"fine": True})]
+
+
+# ---------------------------------------------------------------------- #
+# real engines: drain semantics + an end-to-end fleet
+# ---------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    model = CausalLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, model, params
+
+
+def test_engine_drain_stops_admission_and_finishes_seats(tiny_model):
+    from accelerate_tpu.serving import ServingEngine
+
+    _, model, params = tiny_model
+    engine = ServingEngine(model, params, max_slots=1, block_size=4, seed=0)
+    rids = [
+        engine.add_request(list(range(1, 6)), max_new_tokens=3)
+        for _ in range(3)
+    ]
+    engine.step()  # seats the first request
+    harvested = engine.drain()
+    assert [r.request_id for r in harvested] == rids[1:]
+    assert engine.health() == {"ok": True, "state": "draining"}
+    late = engine.add_request([1, 2, 3], max_new_tokens=2)
+    assert engine.shed_reason(late) == "draining"
+    assert engine.scheduler.shed_counts["draining"] == 1
+    while engine.has_work:  # the seated request still finishes
+        engine.step()
+    assert engine.result(rids[0]) is not None
+    engine.undrain()
+    assert engine.health()["state"] == "serving"
+    ok = engine.add_request([1, 2, 3], max_new_tokens=2)
+    while engine.has_work:
+        engine.step()
+    assert engine.result(ok) is not None
+
+
+def test_engine_prefix_digest_scoped_and_bounded(tiny_model):
+    from accelerate_tpu.serving import ServingEngine
+
+    _, model, params = tiny_model
+    engine = ServingEngine(
+        model, params, max_slots=2, block_size=4, seed=0,
+        prefix_cache=True, model_fingerprint="digest-test",
+    )
+    engine.add_request(list(range(1, 14)), max_new_tokens=2)
+    while engine.has_work:
+        engine.step()
+    digest = engine.prefix_digest()
+    assert digest["enabled"] and digest["fingerprint"] == "digest-test"
+    expected = prefix_keys("digest-test", None, list(range(1, 14)), 4)
+    assert set(digest["entries"]) >= {k.hex() for k in expected}
+
+
+def test_fleet_e2e_affinity_beats_round_robin(tiny_model):
+    """Three REAL engines on a shared fake clock: prefix-affinity must
+    concentrate each cohort's chain on one replica (strictly more cache
+    hits than round-robin), outputs must match across policies, and
+    every replica must hold ONE compiled decode program."""
+    from accelerate_tpu.serving import ServingEngine
+
+    _, model, params = tiny_model
+    cohorts = [[10 + c] * 8 for c in range(2)]
+    prompts = [
+        cohorts[i % 2] + [30 + i, 31 + i] for i in range(8)
+    ]
+
+    def run(policy):
+        clock = FakeClock()
+        engines = [
+            ServingEngine(
+                model, params, max_slots=2, block_size=4, seed=0,
+                prefix_cache=True, model_fingerprint="fleet-e2e",
+                now=clock,
+            )
+            for _ in range(3)
+        ]
+        router = FleetRouter(
+            [InProcessReplica(f"r{i}", e) for i, e in enumerate(engines)],
+            policy=policy, now=clock,
+        )
+        rids = []
+        for p in prompts:
+            rids.append(router.add_request(list(p), max_new_tokens=3))
+            _drain_fleet(router, clock, budget=500)
+        outs = [router.result(r) for r in rids]
+        hits = sum(e.prefix_cache.stats()["hits"] for e in engines)
+        decodes = [e.trace_counts().get("decode", 0) for e in engines]
+        return outs, hits, decodes
+
+    rr_outs, rr_hits, rr_decodes = run("round_robin")
+    af_outs, af_hits, af_decodes = run("prefix_affinity")
+    assert af_outs == rr_outs  # placement changes WHERE, never WHAT
+    assert all(o is not None for o in af_outs)
+    assert af_hits > rr_hits
+    assert af_hits == len(prompts) - 2  # all but each cohort's opener
+    # zero decode retraces on every replica: one compiled decode each
+    # (0 allowed only for a replica that never decoded)
+    assert all(d <= 1 for d in af_decodes + rr_decodes)
