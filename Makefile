@@ -35,12 +35,13 @@ FAST_FILES = \
   tests/test_slice_mesh.py tests/test_adapters.py \
   tests/test_prefix_cache.py tests/test_speculation.py \
   tests/test_profiling.py tests/test_loadgen.py \
-  tests/test_capacity.py tests/test_router.py
+  tests/test_capacity.py tests/test_router.py \
+  tests/test_disagg.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
   diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke \
   slice-smoke kernels-smoke lora-smoke prefix-smoke spec-smoke mem-smoke \
-  soak-smoke capacity-smoke router-smoke
+  soak-smoke capacity-smoke router-smoke disagg-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -222,6 +223,16 @@ soak-smoke:
 # outputs under affinity vs round-robin with strictly more warm hits
 router-smoke:
 	JAX_PLATFORMS=cpu $(PYTEST) -q tests/test_router.py
+
+# prefill/decode disaggregation acceptance on CPU (~35s): greedy
+# outputs across the block-granular KV hand-off are BITWISE the
+# colocated engine's (bf16 and int8), the int8 swap payload round-trips
+# exactly (scale rows included), manifest seating dedups against the
+# decode replica's CACHED index, and the transfer_stall / transfer_drop
+# chaos arms bound damage to a re-queue — no request lost, no seated
+# decode disturbed, measured recovery
+disagg-smoke:
+	JAX_PLATFORMS=cpu $(PYTEST) -q tests/test_disagg.py
 
 # diagnostics end-to-end on CPU: a tiny train loop with an injected slow
 # step and an injected NaN gradient runs with the flight recorder on,

@@ -1893,6 +1893,378 @@ def _run_fleet_soak(cfg, max_slots: int, block_size: int,
     }
 
 
+def _run_disagg_soak(cfg, max_slots: int, block_size: int,
+                     target_requests: int, seed: int,
+                     partial: Optional[PartialWriter] = None):
+    """Prefill/decode disaggregation A/B (PR 19): the SAME seeded
+    bursty long-prompt trace replays against two four-chip fleets on
+    the virtual clock —
+
+      colocated — four ``role="colocated"`` replicas (the PR 18 fleet),
+      disagg    — two prefill replicas hand finished KV chains to two
+                  decode replicas through the router's transfer ledger
+                  (``placement="disagg"``, host_buffer plane).
+
+    The headline is the decode-side EXPERIENCE: soak-window p95
+    inter-token latency. The run uses the harness's ``step_cost`` hook
+    (built for exactly this) to charge compute serialization: a
+    replica's step that issued prefill work while it was HOSTING seated
+    decodes stretches by the padded prefill bucket — on a colocated
+    engine a giant prompt's ingestion holds that replica's whole decode
+    batch for one long step. Replicas are parallel chips, so the fleet
+    step charges the slowest such replica; a prefill-role replica's
+    ingestion overlaps the decode pool's stepping (it hosts no decode
+    seats — the disaggregation claim), and a decode-role replica never
+    runs a prefill program at all, so the disagg decode pool steps at
+    the flat quantum through the burst. ``vs_baseline`` is
+    colocated-p95-ITL / disagg-p95-ITL (> 1 means the split strictly
+    wins), and the record also reports the goodput@SLO ratio (>= 1
+    means disaggregation pays for itself on the same four chips), the
+    plane's block dedup ratio (warm cohort prefixes ride the decode
+    pool's CACHED index instead of the wire), and the per-pool
+    zero-retrace contract: decode replicas compile ZERO prefill or
+    decode programs after priming.
+
+    A third arm re-runs the disagg topology with
+    ``transfer_stall@0:secs=1`` wedging the transfer plane mid-soak:
+    damage must be bounded to requests awaiting hand-off (none lost,
+    re-queued or delivered after the stall lifts) with measured
+    recovery. A closed-loop probe asserts greedy outputs across the
+    hand-off are BITWISE the colocated engine's.
+    """
+    import os
+
+    from accelerate_tpu.loadgen import (
+        Phase,
+        SoakClock,
+        SoakConfig,
+        SoakHarness,
+        WorkloadConfig,
+    )
+    from accelerate_tpu.models import CausalLM, count_params
+    from accelerate_tpu.parallel.sharding import unbox_params
+    from accelerate_tpu.router import FleetRouter, InProcessReplica
+    from accelerate_tpu.serving import ServingEngine, TransferPlane
+    from accelerate_tpu.serving.telemetry import ServeStats
+
+    partial = partial or _noop_writer("disagg_soak")
+    _reset_state()
+    model = CausalLM(cfg)
+    abstract = unbox_params(
+        jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )
+        )
+    )["params"]
+    leaves, treedef = jax.tree_util.tree_flatten(abstract)
+    keys = jax.random.split(jax.random.PRNGKey(0), len(leaves))
+
+    @jax.jit
+    def init_bf16():
+        return jax.tree_util.tree_unflatten(treedef, [
+            jax.random.normal(k, l.shape, jnp.bfloat16)
+            * (0.02 if l.ndim > 1 else 1.0)
+            for k, l in zip(keys, leaves)
+        ])
+
+    params = init_bf16()
+    n_params = count_params(params)
+
+    n_prefill = n_decode = 2
+    n_replicas = n_prefill + n_decode
+    prefix_tokens = 3 * block_size   # cohort prefix: 3 full chain blocks
+    long_tokens = 8 * block_size     # the burst giants' prompt body
+    workload = WorkloadConfig(
+        vocab_size=cfg.vocab_size,
+        num_cohorts=4,
+        prefix_tokens=prefix_tokens,
+        cohort_fraction=0.8,
+        prompt_tokens_min=2,
+        prompt_tokens_median=4,
+        prompt_tokens_max=2 * block_size,
+        long_prompt_fraction=0.25,
+        long_prompt_tokens=long_tokens,
+        output_tokens_min=2,
+        output_tokens_median=6,
+        output_tokens_max=16,
+        max_total_tokens=cfg.max_seq_len,
+    )
+
+    ab_dt = 0.01  # virtual seconds per fleet step (one step per replica)
+    # offered load sized to the DISAGG bottleneck — the two-replica
+    # decode pool. The colocated fleet spends the same four chips, so a
+    # goodput ratio >= 1 means the split pays for itself at this rate.
+    vcap = n_decode * max_slots / (
+        (2 + workload.output_tokens_median) * ab_dt
+    )
+    u = max(0.2, target_requests / (1.35 * vcap))
+    ab_phases = (
+        Phase("warmup", "warmup", u, 0.25 * vcap),
+        Phase("burst", "soak", 2 * u, 0.55 * vcap),
+    )
+    stall_phases = (
+        Phase("warmup", "warmup", u, 0.25 * vcap),
+        Phase("soak", "soak", u, 0.55 * vcap),
+        Phase("fault", "fault", u, 0.55 * vcap),
+        Phase("recovery", "recovery", 2 * u, 0.55 * vcap),
+    )
+
+    max_prompt = prefix_tokens + long_tokens
+    prime_lens = []
+    m = 2
+    while m < 2 * max_prompt and m + 2 <= cfg.max_seq_len:
+        prime_lens.append(min(m, max_prompt))
+        m *= 2
+
+    def _prime(eng):
+        """Compile every prefill bucket plus the decode program BEFORE
+        the arm starts (every replica primes COLOCATED — roles are
+        assigned after), then reset stats and the prefix index; the
+        zero-retrace deltas are taken from this point."""
+        rng_p = np.random.default_rng(seed + 99)
+        for n in prime_lens:
+            eng.add_request(
+                rng_p.integers(1, workload.vocab_size, size=n).tolist(),
+                max_new_tokens=2,
+            )
+        while eng.has_work:
+            eng.step()
+        eng.set_prefix_cache(False)
+        eng.set_prefix_cache(True, "disagg-bench")
+        eng.stats = ServeStats()
+
+    def _arm(name, phases, disagg, fault=""):
+        clock = SoakClock()
+        plane = TransferPlane("host_buffer", now=clock) if disagg else None
+        roles = (
+            ["prefill"] * n_prefill + ["decode"] * n_decode
+            if disagg else ["colocated"] * n_replicas
+        )
+        engines = []
+        for role in roles:
+            eng = ServingEngine(
+                model, params, max_slots=max_slots,
+                block_size=block_size, now=clock,
+                prefix_cache=True, model_fingerprint="disagg-bench",
+                transfer_plane=plane,
+            )
+            _prime(eng)
+            if role != "colocated":
+                eng.set_role(role)
+            engines.append(eng)
+        primed = [dict(e.trace_counts()) for e in engines]
+
+        # compute-serialization cost model: a replica whose step issued
+        # prefill work while it entered the step with seated decodes
+        # stalls those decodes for the prefill's duration (the padded
+        # bucket); parallel replicas overlap, so the fleet step charges
+        # the slowest decode-hosting one. Seed the counters at the
+        # post-priming totals so priming's buckets are not billed.
+        # a full giant bucket (16 blocks) bills 8 decode quanta — far
+        # below its real compute ratio vs a 2-row decode step, so the
+        # colocated arm is charged conservatively
+        prefill_cost = ab_dt / (2 * block_size)  # virtual s per token
+        issued_at = {id(e): e.prefill_bucket_tokens_total for e in engines}
+        hosted = {id(e): 0 for e in engines}
+
+        def _step_cost(_router):
+            surcharge = 0.0
+            for e in engines:
+                issued = e.prefill_bucket_tokens_total - issued_at[id(e)]
+                issued_at[id(e)] = e.prefill_bucket_tokens_total
+                if issued and hosted[id(e)]:
+                    surcharge = max(surcharge, issued * prefill_cost)
+                hosted[id(e)] = sum(
+                    1 for s in e.scheduler.slots
+                    if s.busy and not s.done and not s.mid_prefill
+                )
+            return ab_dt + surcharge
+
+        router = FleetRouter(
+            [
+                InProcessReplica(f"{role[0]}{i}", eng)
+                for i, (role, eng) in enumerate(zip(roles, engines))
+            ],
+            policy="prefix_affinity", now=clock,
+            placement="disagg" if disagg else "colocated",
+            transfer_plane=plane,
+        )
+        arm_path = (
+            os.path.join(
+                os.path.dirname(partial.path),
+                f"soak-report-disagg-{name}.json",
+            ) if partial.path else None
+        )
+        arm_cfg = SoakConfig(
+            workload=workload, phases=phases, seed=seed + 17,
+            step_dt_s=ab_dt, step_cost=_step_cost, fault_specs=fault,
+            report_path=arm_path, drain_grace_s=60.0,
+            label=f"disagg_soak_{name}",
+        )
+        rep = SoakHarness(router, arm_cfg, clock=clock).run()
+        out = {
+            "report": rep,
+            "goodput": rep["headline"]["goodput_tokens_per_s_at_slo"],
+            "p95_itl_s": rep["headline"].get("soak_p95_itl_s"),
+            # per-pool zero-retrace: programs compiled since priming
+            "decode_retraces": sum(
+                e.trace_counts().get("decode", 0) - p.get("decode", 0)
+                for e, p in zip(engines, primed)
+            ),
+            "decode_pool_prefills": sum(
+                e.trace_counts().get("prefill", 0) - p.get("prefill", 0)
+                for e, p, role in zip(engines, primed, roles)
+                if role == "decode"
+            ),
+            "transfer": rep.get("transfer") or {},
+            "router": rep.get("router") or {},
+            "report_path": arm_path,
+        }
+        partial.update(
+            phase=f"disagg_{name}",
+            metric="soak_p95_itl_s",
+            value=out["p95_itl_s"], unit="s",
+            extra={"goodput_tokens_per_s_at_slo": out["goodput"]},
+        )
+        return out
+
+    def _bitwise_probe():
+        """Closed-loop greedy determinism check: the same prompts
+        through a colocated engine and a hand-pumped prefill->decode
+        pair must produce IDENTICAL results."""
+        rng_b = np.random.default_rng(seed + 7)
+        prompts = [
+            rng_b.integers(1, cfg.vocab_size, size=n).tolist()
+            for n in (block_size + 4, 2 * block_size,
+                      3 * block_size + 1, 5)
+        ]
+
+        def _mk(role="colocated", plane=None):
+            return ServingEngine(
+                model, params, max_slots=max_slots,
+                block_size=block_size, prefix_cache=True,
+                model_fingerprint="disagg-bench", role=role,
+                transfer_plane=plane,
+            )
+
+        base_eng = _mk()
+        rids = [
+            base_eng.add_request(p, max_new_tokens=8, request_id=f"bw{i}")
+            for i, p in enumerate(prompts)
+        ]
+        while base_eng.has_work:
+            base_eng.step()
+        base = {r: base_eng.result(r) for r in rids}
+        plane = TransferPlane("host_buffer")
+        pre = _mk("prefill", plane)
+        dec = _mk("decode", plane)
+        for i, p in enumerate(prompts):
+            pre.add_request(p, max_new_tokens=8, request_id=f"bw{i}")
+        for _ in range(500):
+            if not (pre.has_work or dec.has_work):
+                break
+            pre.step()
+            for mani in pre.pop_manifests():
+                dec.acquire(mani)
+            dec.step()
+        return {r: dec.result(r) for r in rids} == base
+
+    t0 = time.perf_counter()
+    colo = _arm("colocated", ab_phases, disagg=False)
+    dis = _arm("disagg", ab_phases, disagg=True)
+    stall = _arm(
+        "transfer_stall", stall_phases, disagg=True,
+        fault="transfer_stall@0:secs=1",
+    )
+    bitwise = _bitwise_probe()
+    disagg_wall_s = time.perf_counter() - t0
+
+    fault_rep = stall["report"]["fault"]
+    plane_sum = (dis["transfer"].get("plane") or {})
+    colo_itl, dis_itl = colo["p95_itl_s"], dis["p95_itl_s"]
+
+    def _arm_extra(a):
+        return {
+            "goodput_tokens_per_s_at_slo": (
+                round(a["goodput"], 1) if a["goodput"] is not None else None
+            ),
+            "soak_p95_itl_s": (
+                round(a["p95_itl_s"], 5)
+                if a["p95_itl_s"] is not None else None
+            ),
+            "decode_retraces": a["decode_retraces"],
+            "decode_pool_prefills": a["decode_pool_prefills"],
+            "requests_finished": a["report"]["requests_finished"],
+            "requests_shed": a["report"]["requests_shed"],
+            "transfers_delivered": a["transfer"].get("delivered_total"),
+            "transfers_dropped": a["transfer"].get("dropped_total"),
+        }
+
+    return {
+        "metric": "disagg_soak_p95_itl_s",
+        "value": round(dis_itl, 5) if dis_itl is not None else None,
+        "unit": "s",
+        # acceptance bar: the decode pool's burst-window p95 ITL is
+        # STRICTLY better than colocated — > 1 means disagg wins
+        "vs_baseline": (
+            round(colo_itl / dis_itl, 3)
+            if colo_itl and dis_itl else None
+        ),
+        "extra": {
+            "n_prefill": n_prefill,
+            "n_decode": n_decode,
+            "max_slots_per_replica": max_slots,
+            "block_size": block_size,
+            "long_prompt_fraction": workload.long_prompt_fraction,
+            "long_prompt_tokens": long_tokens,
+            "colocated_p95_itl_s": (
+                round(colo_itl, 5) if colo_itl is not None else None
+            ),
+            # same four chips: >= 1 means the split costs no goodput
+            "goodput_ratio_disagg_vs_colocated": (
+                round(dis["goodput"] / colo["goodput"], 3)
+                if dis["goodput"] and colo["goodput"] else None
+            ),
+            "dedup_ratio": plane_sum.get("dedup_ratio"),
+            "blocks_moved_total": plane_sum.get("blocks_moved_total"),
+            "blocks_deduped_total": plane_sum.get("blocks_deduped_total"),
+            "bytes_moved_total": plane_sum.get("bytes_moved_total"),
+            "transfer_ms_p95": plane_sum.get("transfer_ms_p95"),
+            "bitwise_identical": bitwise,
+            "arms": {
+                "colocated": _arm_extra(colo),
+                "disagg": _arm_extra(dis),
+                "transfer_stall": _arm_extra(stall),
+            },
+            # transfer_stall chaos arm: damage bounded to the hand-off
+            "stall_requests_lost": stall["router"].get("requests_lost"),
+            "stall_requests_requeued": (
+                stall["router"].get("requests_requeued")
+            ),
+            "stall_transfer_recovery_s": (
+                stall["transfer"].get("stall_recovery_s")
+            ),
+            "stall_sheds_in_window": fault_rep["sheds_in_window"],
+            "stall_slo_violations_in_window": (
+                fault_rep["slo_violations_in_window"]
+            ),
+            "stall_recovery_s": fault_rep["recovery_s"],
+            "stall_recovered": fault_rep["recovered"],
+            "stall_report_path": stall["report_path"],
+            "report_paths": {
+                "colocated": colo["report_path"],
+                "disagg": dis["report_path"],
+            },
+            "disagg_wall_s": round(disagg_wall_s, 3),
+            "virtual_capacity_rps": round(vcap, 1),
+            "unit_s": round(u, 3),
+            "params": n_params,
+            "device": _device_kind(),
+        },
+    }
+
+
 def _run_overhead(cfg, batch_size: int, seq: int, iters: int, warmup: int,
                   partial: Optional[PartialWriter] = None):
     """Telemetry+diagnostics ON-vs-OFF A/B: the harness proving ITSELF
@@ -2316,6 +2688,13 @@ def result_line(variant, partial: Optional[PartialWriter] = None) -> dict:
         )
         rec["extra"].update(probe())
         productive_s = rec["extra"]["fleet_wall_s"]
+    elif kind == "disagg_soak":
+        max_slots, block_size, n_requests, seed = batch_size, seq, iters, warmup
+        rec = _run_disagg_soak(
+            cfg, max_slots, block_size, n_requests, seed, partial=partial
+        )
+        rec["extra"].update(probe())
+        productive_s = rec["extra"]["disagg_wall_s"]
     elif kind == "lora":
         rec = _run_lora(cfg, batch_size, seq, iters, warmup, partial=partial)
         rec["extra"].update(probe())

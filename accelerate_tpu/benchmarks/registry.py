@@ -25,7 +25,7 @@ ENV_ITERS = "ACCELERATE_TPU_BENCH_ITERS"  # test/debug: stretch train loops
 @dataclass(frozen=True)
 class Variant:
     name: str
-    kind: str  # "train" | "ckpt" | "accum" | "decode" | "decode_load" | "serve" | "serve_soak" | "fleet_soak" | "overhead" | "lora"
+    kind: str  # "train" | "ckpt" | "accum" | "decode" | "decode_load" | "serve" | "serve_soak" | "fleet_soak" | "disagg_soak" | "overhead" | "lora"
     priority: int
     group: str
     args: tuple = field(default_factory=tuple)
@@ -184,6 +184,13 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
             # block_size, target_requests_per_arm, seed)
             _variant("fleet_soak", "fleet_soak", 5, "serve",
                      (tiny, 2, 8, 64, 0), default_estimate_s=180),
+            # prefill/decode disaggregation A/B: 2 prefill + 2 decode
+            # replicas hand off KV chains through the router's transfer
+            # ledger vs 4 colocated replicas on the SAME bursty
+            # long-prompt trace, plus a transfer_stall chaos arm.
+            # args mirror fleet_soak's
+            _variant("disagg_soak", "disagg_soak", 5, "serve",
+                     (tiny, 2, 8, 48, 0), default_estimate_s=240),
             _variant("ckpt", "ckpt", 3, "ckpt", (tiny, 4, 64, 8, 2),
                      fast=True, default_estimate_s=15),
             # adapter-only vs full fine-tune economics + the multi-tenant
@@ -327,6 +334,12 @@ def build_registry(on_tpu: bool) -> VariantRegistry:
         # pauses); 4 arms x 4 replicas drive the estimate
         _variant("fleet_soak", "fleet_soak", 5, "decode",
                  (decode, 2, 16, 48, 0), default_estimate_s=1600),
+        # disaggregated prefill/decode on the ~5.5B decode model:
+        # 3 arms x 4 replicas (2P+2D or 4 colocated) plus the bitwise
+        # hand-off probe — the block transfers ride the PR 17 swap
+        # programs already in each replica's compile budget
+        _variant("disagg_soak", "disagg_soak", 5, "decode",
+                 (decode, 2, 16, 32, 0), default_estimate_s=1600),
         _variant("moe", "train", 3, "moe", (moe, 16, 1024, 20, 3),
                  default_estimate_s=600),
         _variant("longseq", "train", 3, "longseq", (longseq, 1, 8192, 8, 2),

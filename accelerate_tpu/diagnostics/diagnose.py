@@ -586,6 +586,29 @@ def format_report(report: dict) -> str:
             )
             if per:
                 lines.append(f"    placement: {per}")
+        transfer = rep.get("transfer") or {}
+        if transfer:
+            plane = transfer.get("plane") or {}
+            lines.append(
+                f"  transfer: placement={transfer.get('placement')}"
+                f" delivered={transfer.get('delivered_total') or 0}"
+                f" in_flight={transfer.get('in_flight') or 0}"
+                f" dedup_ratio={plane.get('dedup_ratio') or 0:.2f}"
+                f" bytes={plane.get('bytes_moved_total') or 0}"
+                f" p95_ms={plane.get('transfer_ms_p95') or 0:.2f}"
+                + (
+                    f" stalls={transfer['stalls_total']}"
+                    f" (recovered in "
+                    f"{transfer.get('stall_recovery_s') or 0:.2f}s)"
+                    if transfer.get("stalls_total")
+                    else ""
+                )
+                + (
+                    f" dropped={transfer['dropped_total']}"
+                    if transfer.get("dropped_total")
+                    else ""
+                )
+            )
         top_shed = sorted(
             (rep.get("shed_totals") or {}).items(), key=lambda kv: -kv[1]
         )[:3]

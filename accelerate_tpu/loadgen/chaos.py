@@ -25,6 +25,14 @@ damage measured instead of hoped about:
   step loop for S seconds so placement must route around it. Against a
   single engine both are skipped with an event (``not_a_fleet``) —
   existing soaks can never be broken by a fleet spec.
+* ``transfer_stall@step[:secs=S][:replica=N]`` /
+  ``transfer_drop@step[:replica=N]`` — disaggregation faults: stall
+  wedges KV hand-off delivery (the transfer ledger waits, seated
+  decodes keep stepping), drop loses every in-flight manifest on the
+  wire (damage bounded to a re-queue — each dropped chain's prompt
+  re-prefills under its original id). ``replica=N`` filters to one
+  SOURCE prefill replica; omitted = all sources. Against a
+  non-disagg engine both skip with ``not_a_disagg_fleet``.
 
 Handlers install on a :class:`FaultInjector` via ``install_handler`` —
 spec *steps* are engine steps, and the soak harness shifts them to be
@@ -220,4 +228,42 @@ class ChaosAdapter:
         self.engine.slow(rep.name, secs)
         self._event(
             "replica_slow", step=spec.step, replica=rep.name, secs=secs
+        )
+
+    # -- transfer faults (engine is a disagg FleetRouter) ---------------- #
+    def _transfer_src(self, action: str, spec: FaultSpec):
+        """Resolve the optional ``replica=`` source filter for a
+        transfer fault: None targets ALL in-flight hand-offs. Returns
+        ``(ok, name)`` — a non-disagg engine skips with an event, like
+        the fleet faults on a single engine."""
+        if not hasattr(self.engine, "stall_transfers"):
+            self._event(action, step=spec.step, skipped="not_a_disagg_fleet")
+            return False, None
+        if spec.replica is None:
+            return True, None
+        replicas = getattr(self.engine, "replicas", None) or []
+        if not 0 <= spec.replica < len(replicas):
+            self._event(action, step=spec.step, replica=spec.replica,
+                        skipped="replica_out_of_range")
+            return False, None
+        return True, replicas[spec.replica].name
+
+    def _on_transfer_stall(self, spec: FaultSpec) -> None:
+        ok, name = self._transfer_src("transfer_stall", spec)
+        if not ok:
+            return
+        secs = spec.stall_secs or DEFAULT_STALL_SECS
+        self.engine.stall_transfers(secs, replica=name)
+        self._event(
+            "transfer_stall", step=spec.step, replica=name, secs=secs
+        )
+
+    def _on_transfer_drop(self, spec: FaultSpec) -> None:
+        ok, name = self._transfer_src("transfer_drop", spec)
+        if not ok:
+            return
+        outcome = self.engine.drop_transfers(replica=name)
+        self._event(
+            "transfer_drop", step=spec.step, replica=name,
+            dropped=outcome["dropped"],
         )

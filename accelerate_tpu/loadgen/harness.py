@@ -103,7 +103,8 @@ def _phase_acc(phase: Phase) -> dict:
     return {
         "phase": phase, "offered": 0, "finished": 0, "new_tokens": 0,
         "goodput_tokens": 0, "slo_violations": 0, "sheds": {},
-        "ttfts": [], "lags": [], "breach_seen": False, "ran_s": 0.0,
+        "ttfts": [], "itls": [], "lags": [], "breach_seen": False,
+        "ran_s": 0.0,
     }
 
 
@@ -331,6 +332,12 @@ class SoakHarness:
             "slo_violations": acc["slo_violations"],
             "p50_ttft_s": percentile(ttfts, 50) if ttfts else None,
             "p95_ttft_s": percentile(ttfts, 95) if ttfts else None,
+            "p50_itl_s": (
+                percentile(acc["itls"], 50) if acc["itls"] else None
+            ),
+            "p95_itl_s": (
+                percentile(acc["itls"], 95) if acc["itls"] else None
+            ),
             "arrival_lag_p95_s": (
                 percentile(acc["lags"], 95) if acc["lags"] else 0.0
             ),
@@ -397,6 +404,11 @@ class SoakHarness:
         met = ttft is not None and (obj is None or ttft <= obj)
         if ttft is not None:
             acc["ttfts"].append(float(ttft))
+        # inter-token latency: the decode-side experience a prefill
+        # burst degrades on a colocated engine (the disagg headline)
+        dtps = fields.get("decode_tokens_per_s")
+        if dtps:
+            acc["itls"].append(1.0 / float(dtps))
         if met:
             acc["goodput_tokens"] += new_tokens
         else:
@@ -546,6 +558,13 @@ class SoakHarness:
         rsum = getattr(self.engine, "router_summary", None)
         if rsum is not None:
             report["router"] = rsum()
+        # disagg fleets: the KV hand-off ledger (plane totals, dedup
+        # ratio, per-role replica gauges, stall/drop damage)
+        tsum = getattr(self.engine, "transfer_summary", None)
+        if tsum is not None:
+            section = tsum()
+            if section:
+                report["transfer"] = section
         self._emit_soak_final(report)
         if cfg.report_path:
             write_report(cfg.report_path, report)
@@ -557,11 +576,13 @@ class SoakHarness:
         obj = self._ttft_objective()
         goodput = soaks[-1]["goodput_tokens_per_s"] if soaks else None
         p95 = soaks[-1]["p95_ttft_s"] if soaks else None
+        p95_itl = soaks[-1].get("p95_itl_s") if soaks else None
         ok_rates = [p["offered_rps"] for p in ramps if not p["breached"]]
         breach_found = any(p["breached"] for p in ramps)
         return {
             "goodput_tokens_per_s_at_slo": goodput,
             "soak_p95_ttft_s": p95,
+            "soak_p95_itl_s": p95_itl,
             "ttft_objective_s": obj,
             "slo_ok": (
                 p95 is not None and obj is not None and p95 <= obj

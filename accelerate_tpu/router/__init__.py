@@ -26,6 +26,15 @@ Three composable placement policies (:mod:`.policies`):
 Session affinity rides on top of any base policy: bounded per-key
 state, graceful spill when the pinned replica drains or dies.
 
+Prefill/decode disaggregation (PR 19) builds on the same machinery:
+``FleetRouter(placement="disagg")`` splits the fleet by engine role —
+prompts land on the least-loaded prefill replica (prefix affinity
+still applies), and each finished KV chain hands off to the decode
+replica with the deepest cached-chain overlap through an in-flight
+transfer ledger (per-request ``transfer_ms`` + bytes, block dedup
+against the destination's CACHED index, re-queue on a dead endpoint,
+``transfer_stall``/``transfer_drop`` chaos arms).
+
 Everything is default-OFF: nothing in the single-engine path imports or
 consults this package, and a :class:`FleetRouter` only exists where
 user code (or the ``fleet_soak`` bench) builds one. The router is

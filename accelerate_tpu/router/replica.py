@@ -25,6 +25,10 @@ import dataclasses
 import json
 from typing import Any, Optional
 
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
 
 @dataclasses.dataclass
 class ReplicaSnapshot:
@@ -130,12 +134,23 @@ class InProcessReplica:
     def queued_requests(self) -> list:
         """The unadmitted queue entries (``Request`` objects) — what a
         kill-time ejection can still save. Seated requests' KV lives on
-        the dead device; they are LOST, and counted as such."""
+        the dead device; they are LOST, and counted as such.
+
+        Disaggregated roles widen the harvest: manifests still parked
+        in the transfer outbox/inbox never reached a decode seat, and a
+        :class:`~accelerate_tpu.serving.TransferManifest` duck-types as
+        a ``Request`` for re-queueing — those prompts re-prefill on a
+        survivor instead of dying with the replica."""
+        out: list = []
         sched = getattr(self.engine, "scheduler", None)
-        if sched is None:
-            return []
-        out = list(sched.queue)
-        sched.queue.clear()
+        if sched is not None:
+            out.extend(sched.queue)
+            sched.queue.clear()
+        for box in ("_outbox", "_inbox"):
+            pending = getattr(self.engine, box, None)
+            if pending:
+                out.extend(pending)
+                pending.clear()
         return out
 
     def seated_count(self) -> int:
@@ -157,6 +172,8 @@ class HTTPReplica:
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         self._dead = False
+        self.digest_failures_total = 0
+        self._digest_failing = False
 
     @property
     def alive(self) -> bool:
@@ -206,7 +223,30 @@ class HTTPReplica:
         return ReplicaSnapshot.from_gauges(gauges, now)
 
     def fetch_digest(self, max_entries: int) -> dict:
-        return self._get_json("/debug/prefix")
+        """Scrape the cached-chain digest, degrading to an EMPTY digest
+        on error/timeout instead of raising: a dead ``/debug/prefix``
+        must cost this replica its affinity bonus for the tick, not
+        fail placement for the whole fleet — the same
+        staleness-tolerant posture the load snapshot already has. The
+        degraded digest is marked ``stale`` and the failure logged
+        (once per consecutive-failure run, not per tick)."""
+        try:
+            digest = self._get_json("/debug/prefix")
+            self._digest_failing = False
+            return digest
+        except Exception as exc:
+            self.digest_failures_total += 1
+            if not self._digest_failing:
+                self._digest_failing = True
+                logger.warning(
+                    "replica %s /debug/prefix unreachable (%s): serving "
+                    "empty digest (no affinity) until the scrape recovers",
+                    self.name, exc,
+                )
+            return {
+                "entries": [], "block_size": 0, "fingerprint": "",
+                "stale": True,
+            }
 
     # -- placement-only client: no in-band submission ------------------- #
     def add_request(self, prompt, **kwargs) -> str:

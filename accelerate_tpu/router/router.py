@@ -38,7 +38,8 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Iterable, Optional
 
-from .policies import Candidate, make_policy
+from ..serving.transfer import TransferPlane, _TransferRecord
+from .policies import Candidate, load_score, make_policy
 from .replica import ReplicaSnapshot
 
 
@@ -82,10 +83,17 @@ class FleetRouter:
         snapshot_max_age_s: float = 0.0,
         digest_max_age_s: float = 0.05,
         digest_max_entries: int = 512,
+        placement: str = "colocated",
+        transfer_plane: Optional[TransferPlane] = None,
         now: Callable[[], float] = time.monotonic,
     ):
         if max_sessions < 1:
             raise ValueError("max_sessions must be >= 1")
+        if placement not in ("colocated", "disagg"):
+            raise ValueError(
+                f'placement must be "colocated" or "disagg", '
+                f"got {placement!r}"
+            )
         self.policy = make_policy(policy, load_penalty=load_penalty)
         self.session_affinity = session_affinity
         self.max_sessions = max_sessions
@@ -112,6 +120,24 @@ class FleetRouter:
         self.session_spills_total = 0
         self.stale_snapshot_routes_total = 0
         self.ejections_total = 0
+        # PR 19 disaggregation: prompts route onto the prefill pool and
+        # finished KV chains hand off to the decode pool through an
+        # in-flight transfer ledger (see _pump_transfers)
+        self.placement = placement
+        if transfer_plane is None and placement == "disagg":
+            transfer_plane = TransferPlane(now=now)
+        self.transfer_plane = transfer_plane
+        self._transfers: list[_TransferRecord] = []
+        # bounded per-request transfer accounting: rid -> delivery facts
+        self._transfer_log: "OrderedDict[str, dict]" = OrderedDict()
+        self._max_transfer_log = 65536
+        self._transfer_stall_until = 0.0
+        self._transfer_stall_src: Optional[str] = None
+        self._transfer_stall_started: Optional[float] = None
+        self.transfers_delivered_total = 0
+        self.transfers_dropped_total = 0
+        self.transfer_stalls_total = 0
+        self.transfer_stall_recovery_s = 0.0
         self.stats = _FleetStats(self)
         for rep in replicas:
             self.register(rep)
@@ -219,6 +245,17 @@ class FleetRouter:
             if r.alive and not r.draining and name != exclude
         ]
 
+    @staticmethod
+    def _role_of(rep) -> str:
+        return getattr(getattr(rep, "engine", None), "role", None) \
+            or "colocated"
+
+    def _pool(self, role: str, exclude: Optional[str] = None) -> list:
+        return [
+            r for r in self._routable(exclude=exclude)
+            if self._role_of(r) == role
+        ]
+
     def _snapshot(self, rep) -> ReplicaSnapshot:
         now = self._now()
         cached = self._snaps.get(rep.name)
@@ -252,6 +289,10 @@ class FleetRouter:
             raw = rep.fetch_digest(self.digest_max_entries)
         except Exception:
             return cached  # stale digest beats no digest
+        if raw.get("stale") and cached is not None:
+            # the handle degraded to an empty placeholder (scrape
+            # error/timeout): last-known-good still beats it
+            return cached
         entry = {
             "at": now,
             "keys": set(raw.get("entries") or ()),
@@ -290,9 +331,20 @@ class FleetRouter:
         deployment's ingress does the submission when replicas are
         HTTP handles). Raises ``RuntimeError`` when no live,
         non-draining replica exists."""
-        routable = self._routable(exclude=_exclude)
-        if not routable:
-            raise RuntimeError("no live non-draining replica to route to")
+        if self.placement == "disagg":
+            # prompts only ever land on the prefill pool — decode
+            # replicas take work exclusively through manifest hand-off
+            routable = self._pool("prefill", exclude=_exclude)
+            if not routable:
+                raise RuntimeError(
+                    "no live non-draining prefill replica to route to"
+                )
+        else:
+            routable = self._routable(exclude=_exclude)
+            if not routable:
+                raise RuntimeError(
+                    "no live non-draining replica to route to"
+                )
         if self.session_affinity and session_id is not None:
             pinned = self._sessions.get(session_id)
             if pinned is not None:
@@ -380,11 +432,224 @@ class FleetRouter:
                 out = rep.step()
                 if out:
                     events.extend(out)
+        if self.placement == "disagg":
+            self._pump_transfers()
         return events
 
     @property
     def has_work(self) -> bool:
-        return any(r.alive and r.has_work for r in self._replicas.values())
+        if any(r.alive and r.has_work for r in self._replicas.values()):
+            return True
+        # in-flight hand-offs are work: a manifest in the ledger still
+        # owes the fleet a seated decode (or a re-queue)
+        return any(
+            rec.state in ("pending", "stalled") for rec in self._transfers
+        )
+
+    # ------------------------------------------------------------------ #
+    # KV hand-off (disagg placement)
+    # ------------------------------------------------------------------ #
+    def _pump_transfers(self) -> None:
+        """Harvest finished prefills into the in-flight ledger, then
+        deliver each manifest to the decode replica with the deepest
+        cached-chain overlap (least-loaded tie-break). Delivery honors
+        an active ``transfer_stall`` window; a dead/refusing endpoint
+        just means the record stays pending for the next pump — and if
+        the decode pool is gone entirely, the prompt re-queues."""
+        now = self._now()
+        for name, rep in self._replicas.items():
+            if not rep.alive:
+                continue
+            pop = getattr(getattr(rep, "engine", None), "pop_manifests", None)
+            if pop is None:
+                continue
+            for m in pop():
+                m.src = name
+                self._transfers.append(
+                    _TransferRecord(manifest=m, started_at=now)
+                )
+        if not self._transfers:
+            return
+        decodes = self._pool("decode")
+        done: list[_TransferRecord] = []
+        for rec in self._transfers:
+            if rec.state not in ("pending", "stalled"):
+                done.append(rec)
+                continue
+            if self._stalled(rec, now):
+                rec.state = "stalled"
+                continue
+            was_stalled = rec.state == "stalled"
+            rec.state = "pending"
+            if not decodes:
+                # decode pool gone: the chain has no destination — give
+                # the prompt back to the prefill pool instead of
+                # stranding the request in the ledger forever
+                self._drop_record(rec, now, reason="no_decode_replica")
+                done.append(rec)
+                continue
+            if self._deliver(rec, decodes, now):
+                if was_stalled and self._transfer_stall_started is not None:
+                    self.transfer_stall_recovery_s = max(
+                        self.transfer_stall_recovery_s,
+                        now - self._transfer_stall_started,
+                    )
+                done.append(rec)
+        for rec in done:
+            self._transfers.remove(rec)
+
+    def _stalled(self, rec: _TransferRecord, now: float) -> bool:
+        if now >= self._transfer_stall_until:
+            return False
+        src = self._transfer_stall_src
+        return src is None or rec.manifest.src == src
+
+    def _deliver(
+        self, rec: _TransferRecord, decodes: list, now: float
+    ) -> bool:
+        m = rec.manifest
+        ranked = []
+        for rep in decodes:
+            digest = self._digest(rep)
+            overlap = 0
+            if digest and digest["keys"]:
+                for k in m.keys:
+                    if k.hex() not in digest["keys"]:
+                        break
+                    overlap += 1
+            snap = self._snapshot(rep)
+            ranked.append(
+                (-overlap, load_score(snap), self._order[rep.name], rep)
+            )
+        ranked.sort(key=lambda t: t[:3])
+        for _, _, _, rep in ranked:
+            rec.attempts += 1
+            try:
+                res = rep.engine.acquire(m)
+            except Exception:
+                continue  # endpoint died mid-delivery: try the next
+            rec.state = "delivered"
+            rec.dst = rep.name
+            rec.done_at = now
+            rec.moved_blocks = int(res.get("moved_blocks", m.n_blocks))
+            rec.deduped_blocks = int(res.get("reused_blocks", 0))
+            rec.moved_bytes = int(
+                res.get("moved_bytes", m.bytes_per_block() * rec.moved_blocks)
+            )
+            self.transfers_delivered_total += 1
+            # the request now lives on the decode replica: result() and
+            # shed_reason() must resolve there
+            self._placements[m.request_id] = rep.name
+            self._placements.move_to_end(m.request_id)
+            ms = (now - rec.started_at) * 1000.0
+            self._transfer_log[m.request_id] = {
+                "src": m.src,
+                "dst": rep.name,
+                "transfer_ms": ms,
+                "bytes": rec.moved_bytes,
+                "blocks_moved": rec.moved_blocks,
+                "blocks_deduped": rec.deduped_blocks,
+                "attempts": rec.attempts,
+            }
+            while len(self._transfer_log) > self._max_transfer_log:
+                self._transfer_log.popitem(last=False)
+            if self.transfer_plane is not None:
+                self.transfer_plane.record_delivery(
+                    m,
+                    src=m.src,
+                    dst=rep.name,
+                    moved_blocks=rec.moved_blocks,
+                    deduped_blocks=rec.deduped_blocks,
+                    moved_bytes=rec.moved_bytes,
+                    ms=ms,
+                )
+            return True
+        return False
+
+    def _drop_record(
+        self, rec: _TransferRecord, now: float, reason: str
+    ) -> None:
+        rec.state = "dropped"
+        rec.done_at = now
+        self.transfers_dropped_total += 1
+        if self.transfer_plane is not None:
+            self.transfer_plane.record_drop(rec.manifest, reason)
+        # a TransferManifest duck-types as a Request for _requeue (same
+        # prompt/knob/id attributes) — the prompt re-prefills from
+        # scratch on the prefill pool, preserving its request_id
+        self._requeue([rec.manifest])
+
+    def stall_transfers(
+        self, secs: float, replica: Optional[str] = None
+    ) -> None:
+        """``transfer_stall`` chaos: wedge hand-off delivery for
+        ``secs`` (all sources, or just ``replica``'s outbound). Seated
+        decodes are untouched — only the ledger waits."""
+        now = self._now()
+        self._transfer_stall_until = now + max(0.0, secs)
+        self._transfer_stall_src = replica
+        self._transfer_stall_started = now
+        self.transfer_stalls_total += 1
+        if self.transfer_plane is not None:
+            self.transfer_plane.record_stall(max(0.0, secs), replica)
+
+    def drop_transfers(self, replica: Optional[str] = None) -> dict:
+        """``transfer_drop`` chaos: every in-flight hand-off (or just
+        ``replica``'s outbound) is lost on the wire. Damage is bounded
+        to a re-queue: each dropped chain's prompt goes back to the
+        prefill pool under its original request id."""
+        now = self._now()
+        dropped = 0
+        for rec in list(self._transfers):
+            if rec.state not in ("pending", "stalled"):
+                continue
+            if replica is not None and rec.manifest.src != replica:
+                continue
+            self._drop_record(rec, now, reason="chaos_drop")
+            self._transfers.remove(rec)
+            dropped += 1
+        return {"dropped": dropped}
+
+    def transfer_record(self, request_id: str) -> Optional[dict]:
+        """Per-request hand-off accounting (None = never transferred)."""
+        return self._transfer_log.get(request_id)
+
+    def transfer_summary(self) -> dict:
+        """The soak report's ``transfer`` section: plane totals plus
+        the fleet's per-role hand-off gauges and the ledger posture.
+        Empty for a colocated fleet that never handed anything off —
+        pre-disagg soak reports keep their exact shape."""
+        if (
+            self.placement != "disagg"
+            and self.transfer_plane is None
+            and not self._transfers
+            and not self.transfers_delivered_total
+        ):
+            return {}
+        per_replica = {}
+        for name, rep in self._replicas.items():
+            fn = getattr(getattr(rep, "engine", None), "transfer_gauges",
+                         None)
+            role = self._role_of(rep)
+            if fn is None or role == "colocated":
+                continue
+            per_replica[name] = dict(fn(), role=role)
+        return {
+            "placement": self.placement,
+            "plane": (
+                self.transfer_plane.summary()
+                if self.transfer_plane is not None else None
+            ),
+            "in_flight": sum(
+                1 for rec in self._transfers
+                if rec.state in ("pending", "stalled")
+            ),
+            "delivered_total": self.transfers_delivered_total,
+            "dropped_total": self.transfers_dropped_total,
+            "stalls_total": self.transfer_stalls_total,
+            "stall_recovery_s": self.transfer_stall_recovery_s,
+            "replicas": per_replica,
+        }
 
     def result(self, request_id: str):
         name = self._placements.get(request_id)

@@ -24,6 +24,7 @@ from .spans import (
 )
 from .speculation import DraftModelProposer, NGramProposer, SpecConfig
 from .telemetry import ServeStats, percentile
+from .transfer import TransferManifest, TransferPlane
 
 __all__ = [
     "BlockPool",
@@ -43,6 +44,8 @@ __all__ = [
     "SpanLog",
     "SpecConfig",
     "TokenEvent",
+    "TransferManifest",
+    "TransferPlane",
     "paged_attention",
     "paged_update",
     "percentile",

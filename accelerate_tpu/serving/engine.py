@@ -41,13 +41,14 @@ import numpy as np
 
 from ..logging import get_logger
 from ..ops.attention import PagedKVState
-from .block_pool import BlockPool, PrefixCache
+from .block_pool import BlockPool, PrefixCache, prefix_keys
 from .sampling import SlotSampling, sample_tokens
 from .scheduler import ContinuousScheduler, Request, Slot
 from .slo import SLOConfig, SloTracker
 from .spans import SpanLog, write_chrome_trace
 from .speculation import DraftModelProposer, NGramProposer, SpecConfig
 from .telemetry import ServeStats
+from .transfer import TransferManifest
 
 logger = get_logger(__name__)
 
@@ -152,6 +153,8 @@ class ServingEngine:
         prefill_chunk_tokens: Optional[int] = None,
         preemption: bool = False,
         kv_dtype: str = "bf16",
+        role: str = "colocated",
+        transfer_plane: Any = None,
     ):
         self.model = model
         self.params = params
@@ -182,6 +185,27 @@ class ServingEngine:
         self.kv_dtype = kv_dtype
         kv_state_dtype = "int8" if kv_dtype == "int8" else "native"
         self._kv_state_dtype = kv_state_dtype
+        # prefill/decode disaggregation (PR 19, default OFF): a
+        # "prefill" engine runs prompt ingestion only and publishes each
+        # finished chain as a TransferManifest (chain keys + per-block
+        # host images via the swap path); a "decode" engine acquire()s
+        # manifests, dedups warm prefix blocks against its CACHED index,
+        # scatter-restores only the tail, and seats the request straight
+        # into the decode batch. "colocated" is byte-identical to the
+        # single-engine behavior — none of the hand-off code runs.
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(
+                "role must be 'colocated', 'prefill' or 'decode', "
+                f"got {role!r}"
+            )
+        self._role = role
+        self._plane = transfer_plane
+        self._outbox: list[TransferManifest] = []
+        self._inbox: list[TransferManifest] = []
+        self._transfer_stats = {
+            "manifests_out": 0, "manifests_in": 0, "blocks_moved": 0,
+            "blocks_deduped": 0, "bytes_moved": 0, "seat_deferred": 0,
+        }
         # multi-tenant serving: an AdapterRegistry whose fixed-shape
         # stacks ride every prefill/decode call as traced data, indexed
         # by a per-slot adapter row (the per-slot-temperatures idiom).
@@ -524,7 +548,28 @@ class ServingEngine:
     def has_work(self) -> bool:
         # swapped-out requests hold no queue entry and no seat, but they
         # are still the engine's responsibility until resumed + finished
-        return self.scheduler.has_work or bool(self._swapped_reqs)
+        # (as are acquired-but-unseated manifests on a decode replica)
+        return (
+            self.scheduler.has_work
+            or bool(self._swapped_reqs)
+            or bool(self._inbox)
+        )
+
+    @property
+    def role(self) -> str:
+        return self._role
+
+    def set_role(self, role: str) -> None:
+        """Switch the engine's disaggregation role on a WARM engine.
+        Roles are pure host policy — the compiled programs are shared —
+        so a bench can prime an engine colocated (warming its prefill
+        buckets AND the decode program) and then assign it to a pool."""
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(
+                "role must be 'colocated', 'prefill' or 'decode', "
+                f"got {role!r}"
+            )
+        self._role = role
 
     def trace_counts(self) -> dict:
         """Compiled-program counts, bumped at trace time. After warmup,
@@ -578,6 +623,8 @@ class ServingEngine:
                 self._finish(slot)
         if self.preemption:
             self._try_resume()
+        if self._inbox:
+            self._seat_manifests()
         blocked_before = dict(self.scheduler.blocked_reasons)
         admitted = self.scheduler.admit()
         if self.preemption and self._maybe_preempt(
@@ -597,6 +644,14 @@ class ServingEngine:
                 self._begin_chunked(slot)
         if self.prefill_chunk_tokens is not None:
             self._chunked_prefill_step(events)
+        if self._role == "prefill":
+            # prompt ingestion only: every seat whose prefill just
+            # completed hands its chain off instead of joining the
+            # decode batch (EOS-at-first-token requests are already
+            # slot.done and finish locally — nothing to hand off)
+            for slot in self.scheduler.slots:
+                if slot.busy and not slot.done and not slot.mid_prefill:
+                    self._handoff_slot(slot)
         # mid-prefill seats hold their slot but are not in the decode
         # batch yet (their row carries lengths=0 this step, so the
         # compiled decode shape is untouched)
@@ -1226,6 +1281,211 @@ class ServingEngine:
                 return True
         return False
 
+    # ------------------------------------------------------------------ #
+    # prefill/decode disaggregation (PR 19)
+    # ------------------------------------------------------------------ #
+    def _handoff_slot(self, slot: Slot) -> None:
+        """Package a just-prefilled seat as a :class:`TransferManifest`
+        and release it. The chain's block images leave through the SAME
+        compiled swap gather the preemption path uses (int8 scale rows
+        ride along), so the payload is bitwise what a colocated engine
+        would have held; the chain keys make it content-addressed for
+        decode-side dedup. The seat and its blocks free immediately —
+        a prefill replica's pool only ever funds in-flight ingestion."""
+        req = slot.request
+        used = -(-slot.cache_len // self.block_size)
+        data, nbytes = self._swap_out_blocks(slot.blocks[:used])
+        keys = req.prefix_keys
+        if keys is None:
+            # admission only computes keys when the prefix cache is on;
+            # the manifest needs them regardless (they are its address)
+            keys = prefix_keys(
+                self._model_fingerprint, req.adapter, req.prompt,
+                self.block_size,
+            )
+        manifest = TransferManifest(
+            request_id=req.request_id,
+            prompt=tuple(req.prompt),
+            max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature,
+            eos_token_id=req.eos_token_id,
+            adapter=req.adapter,
+            priority=req.priority,
+            keys=tuple(keys),
+            fingerprint=self._model_fingerprint,
+            block_size=self.block_size,
+            n_blocks=used,
+            cache_len=slot.cache_len,
+            data=data,
+            nbytes=nbytes,
+            first_token=slot.pending,
+            submit_time=req.submit_time,
+            admit_time=slot.admit_time,
+            first_token_time=slot.first_token_time,
+            cached_tokens=slot.cached_tokens,
+            prefill_chunks=slot.chunks,
+        )
+        if self._plane is not None:
+            manifest = self._plane.stage(manifest)
+        self._outbox.append(manifest)
+        self._transfer_stats["manifests_out"] += 1
+        # close the span here: this replica's part of the request's life
+        # ends at hand-off (the decode replica opens its own)
+        self.span_log.on_finish(
+            req.request_id, self._now(), len(slot.generated),
+            accept_rate=None,
+        )
+        self.sampling.clear_slot(slot.index)
+        self._tables[slot.index] = 0
+        self._tables_dev = None
+        self._slot_adapter[slot.index] = 0
+        if self._proposer is not None:
+            self._proposer.release(slot.index)
+        if self.adapters is not None:
+            self.adapters.release(req.adapter)
+        self.scheduler.release(slot)
+
+    def pop_manifests(self) -> list[TransferManifest]:
+        """Drain the prefill outbox (router transfer-pump API)."""
+        out, self._outbox = self._outbox, []
+        return out
+
+    def acquire(self, manifest: TransferManifest) -> dict:
+        """Accept a hand-off. Seats the request immediately when a free
+        slot and its block footprint are available, else parks it in the
+        inbox (seated at the next :meth:`step`, before admission).
+        Returns the placement accounting: ``{"seated": bool}`` plus, when
+        seated, the dedup split (``reused_blocks`` found warm in the
+        local CACHED index vs ``moved_blocks`` scatter-restored from the
+        manifest's host images and their ``moved_bytes``)."""
+        res = self._try_seat_manifest(manifest)
+        if res is None:
+            self._inbox.append(manifest)
+            self._transfer_stats["seat_deferred"] += 1
+            return {"seated": False}
+        return res
+
+    def _seat_manifests(self) -> None:
+        while self._inbox:
+            res = self._try_seat_manifest(self._inbox[0])
+            if res is None:
+                break  # FIFO: no reordering around a big chain
+            self._inbox.pop(0)
+
+    def _try_seat_manifest(self, m: TransferManifest) -> Optional[dict]:
+        free = [s for s in self.scheduler.slots if not s.busy]
+        if not free:
+            return None
+        if self.adapters is not None and not self.adapters.resident(m.adapter):
+            return None
+        used = m.n_blocks
+        full = m.cache_len // self.block_size  # blocks with chain keys
+        total = min(
+            max(used, self.pool.blocks_for_tokens(
+                len(m.prompt) + m.max_new_tokens
+            )),
+            self._max_table,
+        )
+        # warm-prefix dedup: chain-prefix blocks already in the CACHED
+        # index are acquired (refcounted) instead of moved — the
+        # content-addressed keys guarantee bitwise-identical contents,
+        # so only the tail images scatter-restore
+        hits = self.pool.lookup(list(m.keys)[:full])
+        reused = len(hits)
+        if hits:
+            self.pool.acquire(hits)
+        if not self.pool.can_allocate(total - reused):
+            if hits:
+                self.pool.free(hits)
+            return None
+        new = self.pool.allocate(total - reused)
+        tail = used - reused
+        moved_bytes = m.bytes_per_block() * tail
+        if tail:
+            self._restore_blocks(
+                new[:tail], [d[reused:used] for d in m.data]
+            )
+        # index the freshly restored FULL prompt blocks: the next
+        # manifest sharing this chain dedups against them (that is the
+        # decode pool's entire warm set — it never prefills)
+        published: set = set()
+        if self.prefix_cache is not None:
+            for i in range(reused, full):
+                self.pool.publish(new[i - reused], m.keys[i])
+                published.add(i)
+        req = Request(
+            prompt=list(m.prompt),
+            max_new_tokens=m.max_new_tokens,
+            temperature=m.temperature,
+            eos_token_id=m.eos_token_id,
+            request_id=m.request_id,
+            adapter=m.adapter,
+            priority=m.priority,
+        )
+        req.submit_time = m.submit_time
+        req.prefix_keys = list(m.keys)
+        slot = free[0]
+        slot.clear()
+        slot.request = req
+        slot.blocks = list(hits) + new
+        slot.cache_len = m.cache_len
+        slot.generated = [m.first_token]
+        slot.pending = m.first_token
+        slot.chunks = m.prefill_chunks
+        slot.cached_tokens = m.cached_tokens
+        slot.admit_time = m.admit_time
+        slot.first_token_time = m.first_token_time
+        # shared = every position decode must copy-on-write before a
+        # write: the acquired warm hits AND the just-published restores
+        # (decode's first write lands at cache_len — beyond all of them
+        # — so this is the same defensive posture as _decode_step's)
+        slot.shared = set(range(reused)) | published
+        if self.adapters is not None:
+            self.adapters.acquire(req.adapter)
+            self._slot_adapter[slot.index] = self.adapters.slot_of(req.adapter)
+        self.sampling.set_slot(slot.index, req.temperature)
+        self._tables[slot.index] = 0
+        self._tables[slot.index, :len(slot.blocks)] = slot.blocks
+        self._tables_dev = None
+        # replay the lifecycle on this replica's span log with the
+        # manifest's original stamps — queue/TTFT accounting stays
+        # honest across the hop (finish closes the span normally)
+        self.span_log.on_submit(
+            req.request_id, m.submit_time, len(m.prompt),
+            adapter_id=m.adapter,
+        )
+        self.span_log.on_admit(req.request_id, m.admit_time)
+        self.span_log.on_prefill(
+            req.request_id, m.first_token_time,
+            cached_prefix_tokens=m.cached_tokens,
+        )
+        self.span_log.on_first_token(req.request_id, m.first_token_time)
+        if m.eos_token_id is not None and m.first_token == m.eos_token_id:
+            slot.done = True  # defensive: prefill keeps these local
+            slot.finish_time = self._now()
+        if m.max_new_tokens <= 1:
+            slot.done = True
+            slot.finish_time = self._now()
+        stats = self._transfer_stats
+        stats["manifests_in"] += 1
+        stats["blocks_deduped"] += reused
+        stats["blocks_moved"] += tail
+        stats["bytes_moved"] += moved_bytes
+        return {
+            "seated": True,
+            "reused_blocks": reused,
+            "moved_blocks": tail,
+            "moved_bytes": moved_bytes,
+        }
+
+    def transfer_gauges(self) -> dict:
+        """Cumulative hand-off accounting (both directions)."""
+        return dict(
+            self._transfer_stats,
+            transfer_inbox_depth=len(self._inbox),
+            transfer_outbox_depth=len(self._outbox),
+        )
+
     def _decode_step(self, active: list[Slot], events: list[TokenEvent]) -> None:
         tokens = np.zeros((self.max_slots, 1), np.int32)
         cache_lens = np.zeros(self.max_slots, np.int32)
@@ -1468,7 +1728,7 @@ class ServingEngine:
             queue_age_p95 = 0.0
         pool = self.pool.stats()
         active = [s for s in sched.slots if s.busy]
-        return {
+        fields = {
             "engine_steps": self._steps,
             "queue_depth": n_queued,
             "queue_age_p95_s": queue_age_p95,
@@ -1524,6 +1784,13 @@ class ServingEngine:
             "prefill_chunks_total": self._prefill_chunks_total,
             "kv_bytes_per_token": self.kv_bytes_per_token,
         }
+        if self._role != "colocated":
+            # PR 19 disaggregation plane: hand-off accounting only for
+            # pool members — a colocated engine's gauge records stay
+            # byte-identical to the pre-disagg schema
+            fields["role"] = self._role
+            fields.update(self.transfer_gauges())
+        return fields
 
     def _sample_gauges(self) -> None:
         self._tele("record_serve_gauge", **self._gauge_fields())

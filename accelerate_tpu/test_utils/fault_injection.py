@@ -73,15 +73,22 @@ SERVING_ACTIONS = (
     "adapter_churn",
     "replica_kill",
     "replica_slow",
+    "transfer_stall",
+    "transfer_drop",
 )
 
 _ACTIONS = ("kill", "sigterm", "sigint", "hang", "dcn_stall") + SERVING_ACTIONS
 
 #: actions whose ``secs=`` field bounds a stall duration
-_TIMED_ACTIONS = ("dcn_stall", "stall_decode", "pool_pressure", "replica_slow")
+_TIMED_ACTIONS = (
+    "dcn_stall", "stall_decode", "pool_pressure", "replica_slow",
+    "transfer_stall",
+)
 
 #: actions whose ``replica=`` field targets one fleet replica by index
-_REPLICA_ACTIONS = ("replica_kill", "replica_slow")
+_REPLICA_ACTIONS = (
+    "replica_kill", "replica_slow", "transfer_stall", "transfer_drop",
+)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,472 @@
+"""Prefill/decode disaggregation: block-granular KV hand-off.
+
+Three layers, matching the PR's split. Engine: a prefill-role engine
+publishes finished chains as transfer manifests (the existing compiled
+swap gather — int8 scale rows included — so the payload round-trips
+bitwise) and a decode-role engine seats them with CACHED-index dedup
+against the manifest's chain keys. Router: ``placement="disagg"``
+routes prompts to the prefill pool and pumps manifests to the decode
+replica with the deepest cached-chain overlap, with stall/drop chaos
+bounded to a re-queue. The headline invariant everywhere: greedy
+outputs across the hand-off are BITWISE what the colocated engine
+produces, and the decode pool never compiles a prefill program.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.models import CausalLM, TransformerConfig
+from accelerate_tpu.router import FleetRouter, HTTPReplica, InProcessReplica
+from accelerate_tpu.serving import ServingEngine, TransferPlane
+from accelerate_tpu.test_utils.fault_injection import (
+    FaultInjector,
+    FaultSpec,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def tick(self, dt: float = 0.01) -> None:
+        self.t += dt
+
+    def __call__(self) -> float:
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    return model, params
+
+
+PROMPTS = [
+    list(range(3, 15)),   # 12 tokens: 1 full block + tail @ block_size=8
+    list(range(5, 21)),   # 16 tokens: block-aligned
+    list(range(3, 15)),   # identical to [0]: the dedup donor
+    list(range(7, 30)),   # 23 tokens: long
+]
+
+
+def _engine(model, params, role="colocated", plane=None, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefix_cache", True)
+    return ServingEngine(
+        model, params, role=role, transfer_plane=plane, **kw
+    )
+
+
+def _run_colocated(model, params, prompts, **kw):
+    eng = _engine(model, params, **kw)
+    rids = [
+        eng.add_request(p, max_new_tokens=6, request_id=f"r{i}")
+        for i, p in enumerate(prompts)
+    ]
+    while eng.has_work:
+        eng.step()
+    return {rid: eng.result(rid) for rid in rids}
+
+
+def _pump_pair(pre, dec, budget=300):
+    """Drive a prefill/decode engine pair by hand (no router)."""
+    for _ in range(budget):
+        if not (pre.has_work or dec.has_work):
+            return
+        pre.step()
+        for m in pre.pop_manifests():
+            dec.acquire(m)
+        dec.step()
+    raise AssertionError("disagg pair did not drain")
+
+
+# ---------------------------------------------------------------------- #
+# engine roles
+# ---------------------------------------------------------------------- #
+def test_role_validation(tiny_model):
+    model, params = tiny_model
+    with pytest.raises(ValueError, match="role"):
+        _engine(model, params, role="verifier")
+    eng = _engine(model, params)
+    assert eng.role == "colocated"
+    eng.set_role("prefill")
+    assert eng.role == "prefill"
+    with pytest.raises(ValueError, match="role"):
+        eng.set_role("nope")
+
+
+def test_colocated_gauge_schema_is_unchanged(tiny_model):
+    """Disaggregation is default-off: a colocated engine's gauge record
+    carries NO transfer fields — the pre-PR 19 schema byte-for-byte."""
+    model, params = tiny_model
+    eng = _engine(model, params)
+    fields = eng._gauge_fields()
+    assert "role" not in fields
+    assert not any(k.startswith("transfer_") for k in fields)
+    assert "manifests_out" not in fields
+    pre = _engine(model, params, role="prefill")
+    fields = pre._gauge_fields()
+    assert fields["role"] == "prefill"
+    assert fields["manifests_out"] == 0
+
+
+def test_handoff_outputs_bitwise_vs_colocated(tiny_model):
+    model, params = tiny_model
+    base = _run_colocated(model, params, PROMPTS)
+    plane = TransferPlane("inprocess")
+    pre = _engine(model, params, role="prefill", plane=plane)
+    dec = _engine(model, params, role="decode", plane=plane)
+    for i, p in enumerate(PROMPTS):
+        pre.add_request(p, max_new_tokens=6, request_id=f"r{i}")
+    _pump_pair(pre, dec)
+    got = {rid: dec.result(rid) for rid in base}
+    assert got == base
+    # prompt ingestion only: the prefill engine retains no results and
+    # the decode engine compiled ZERO prefill programs
+    assert all(pre.result(rid) is None for rid in base)
+    assert dec.trace_counts()["prefill"] == 0
+    assert dec.trace_counts()["decode"] == 1  # the one (max_slots, 1)
+
+
+def test_manifest_acquire_dedups_cached_blocks(tiny_model):
+    """The CACHED-index dedup satellite: an identical prompt's second
+    hand-off moves ONLY the tail block — every full prompt block is
+    found warm in the decode pool's content index and refcounted
+    instead of restored."""
+    model, params = tiny_model
+    plane = TransferPlane("inprocess")
+    pre = _engine(model, params, role="prefill", plane=plane)
+    dec = _engine(model, params, role="decode", plane=plane)
+    prompt = PROMPTS[0]  # 12 tokens: 1 full block + 4-token tail
+    pre.add_request(prompt, max_new_tokens=4, request_id="a")
+    while pre.has_work:
+        pre.step()
+    (m1,) = pre.pop_manifests()
+    res1 = dec.acquire(m1)
+    assert res1["seated"] and res1["reused_blocks"] == 0
+    assert res1["moved_blocks"] == 2  # full block + partial tail
+    while dec.has_work:
+        dec.step()
+    pre.add_request(prompt, max_new_tokens=4, request_id="b")
+    while pre.has_work:
+        pre.step()
+    (m2,) = pre.pop_manifests()
+    res2 = dec.acquire(m2)
+    assert res2["seated"] and res2["reused_blocks"] == 1
+    assert res2["moved_blocks"] == 1  # only the partial tail moved
+    assert res2["moved_bytes"] == m2.bytes_per_block()
+    while dec.has_work:
+        dec.step()
+    assert dec.result("b") == dec.result("a")
+    gauges = dec.transfer_gauges()
+    assert gauges["blocks_deduped"] == 1 and gauges["manifests_in"] == 2
+
+
+def test_acquire_defers_to_inbox_when_full(tiny_model):
+    model, params = tiny_model
+    plane = TransferPlane("inprocess")
+    pre = _engine(model, params, role="prefill", plane=plane)
+    dec = _engine(model, params, role="decode", plane=plane, max_slots=1)
+    for i in (0, 3):
+        pre.add_request(PROMPTS[i], max_new_tokens=4, request_id=f"r{i}")
+    while pre.has_work:
+        pre.step()
+    manifests = pre.pop_manifests()
+    assert len(manifests) == 2
+    assert dec.acquire(manifests[0])["seated"]
+    assert dec.acquire(manifests[1]) == {"seated": False}
+    assert dec.transfer_gauges()["transfer_inbox_depth"] == 1
+    assert dec.has_work  # the parked manifest IS work
+    while dec.has_work:
+        dec.step()  # seat frees -> inbox drains -> both finish
+    assert dec.result("r0") is not None and dec.result("r3") is not None
+
+
+# ---------------------------------------------------------------------- #
+# int8 swap round-trip (PR 17 x PR 17 interaction)
+# ---------------------------------------------------------------------- #
+def test_int8_swap_roundtrip_is_bitwise_including_scales(tiny_model):
+    """swap_out -> swap_in of int8-quantized KV blocks is bitwise: the
+    quantized codes AND the per-token fp32 scale rows ride the same
+    gather/scatter, so a restored block dequantizes identically."""
+    model, params = tiny_model
+    eng = _engine(model, params, kv_dtype="int8", prefix_cache=False)
+    eng.add_request(PROMPTS[3], max_new_tokens=4, request_id="q")
+    eng.step()  # admit + prefill: blocks now hold real quantized KV
+    (slot,) = [s for s in eng.scheduler.slots if s.busy]
+    blocks = list(slot.blocks)
+    data, nbytes = eng._swap_out_blocks(blocks)
+    assert nbytes > 0
+    dtypes = {d.dtype for d in data}
+    assert np.dtype(np.int8) in dtypes     # quantized K/V pools
+    assert np.dtype(np.float32) in dtypes  # per-token scale rows
+    # scale rows are per-token: (blocks, layers, block_size) fp32
+    scale_leaves = [d for d in data if d.dtype == np.float32]
+    assert scale_leaves and all(
+        d.shape[0] == len(blocks) and d.shape[-1] == eng.block_size
+        for d in scale_leaves
+    )
+    fresh = eng.pool.allocate(len(blocks))
+    eng._restore_blocks(fresh, data)
+    again, nbytes2 = eng._swap_out_blocks(fresh)
+    assert nbytes2 == nbytes
+    for a, b in zip(data, again):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_int8_handoff_outputs_match_int8_colocated(tiny_model):
+    model, params = tiny_model
+    base = _run_colocated(model, params, PROMPTS[:2], kv_dtype="int8")
+    pre = _engine(model, params, role="prefill", kv_dtype="int8")
+    dec = _engine(model, params, role="decode", kv_dtype="int8")
+    for i, p in enumerate(PROMPTS[:2]):
+        pre.add_request(p, max_new_tokens=6, request_id=f"r{i}")
+    _pump_pair(pre, dec)
+    assert {rid: dec.result(rid) for rid in base} == base
+
+
+# ---------------------------------------------------------------------- #
+# disagg routing
+# ---------------------------------------------------------------------- #
+def _disagg_fleet(model, params, clock=None, n_prefill=2, n_decode=2):
+    clock = clock or time.monotonic
+    plane = TransferPlane("inprocess", now=clock)
+    reps = [
+        InProcessReplica(
+            f"p{i}",
+            _engine(model, params, role="prefill", plane=plane, now=clock),
+        )
+        for i in range(n_prefill)
+    ] + [
+        InProcessReplica(
+            f"d{i}",
+            _engine(model, params, role="decode", plane=plane, now=clock),
+        )
+        for i in range(n_decode)
+    ]
+    router = FleetRouter(
+        reps, policy="prefix_affinity", placement="disagg",
+        transfer_plane=plane, now=clock,
+    )
+    return router, plane
+
+
+def _drain(router, budget=500):
+    for _ in range(budget):
+        if not router.has_work:
+            return
+        router.step()
+    raise AssertionError("disagg fleet did not drain")
+
+
+def test_router_disagg_end_to_end_bitwise(tiny_model):
+    model, params = tiny_model
+    base = _run_colocated(model, params, PROMPTS)
+    router, plane = _disagg_fleet(model, params)
+    for i, p in enumerate(PROMPTS):
+        router.add_request(p, max_new_tokens=6, request_id=f"r{i}")
+    _drain(router)
+    assert {rid: router.result(rid) for rid in base} == base
+    summary = router.transfer_summary()
+    assert summary["placement"] == "disagg"
+    assert summary["delivered_total"] == 4
+    assert summary["in_flight"] == 0
+    assert summary["plane"]["transfers_total"] == 4
+    assert 0.0 <= summary["plane"]["dedup_ratio"] <= 1.0
+    rec = router.transfer_record("r0")
+    assert rec is not None and rec["src"].startswith("p")
+    assert rec["dst"].startswith("d") and rec["bytes"] > 0
+    # no prompt ever landed on a decode replica
+    assert all(
+        router.routed_by_replica[f"d{i}"] == 0 for i in range(2)
+    )
+
+
+def test_transfer_stall_damage_bounded_to_waiting(tiny_model):
+    """transfer_stall: deliveries wedge but nothing is lost — every
+    affected request finishes after the window, seated decodes never
+    notice, and the recovery time is reported."""
+    model, params = tiny_model
+    clock = FakeClock()
+    router, plane = _disagg_fleet(model, params, clock=clock)
+    for i, p in enumerate(PROMPTS):
+        router.add_request(p, max_new_tokens=6, request_id=f"r{i}")
+    router.stall_transfers(2.0)
+    for _ in range(50):
+        router.step()
+        clock.tick(0.01)
+    assert router.transfer_summary()["in_flight"] > 0  # wedged, not lost
+    assert router.requests_lost == 0
+    clock.tick(5.0)  # stall expires
+    _drain(router)
+    base = _run_colocated(model, params, PROMPTS)
+    assert {rid: router.result(rid) for rid in base} == base
+    summary = router.transfer_summary()
+    assert router.requests_lost == 0
+    assert summary["stalls_total"] == 1
+    assert summary["stall_recovery_s"] > 0.0
+
+
+def test_transfer_drop_requeues_under_original_id(tiny_model):
+    model, params = tiny_model
+    clock = FakeClock()
+    router, plane = _disagg_fleet(
+        model, params, clock=clock, n_prefill=1, n_decode=1
+    )
+    router.add_request(PROMPTS[0], max_new_tokens=6, request_id="r0")
+    router.stall_transfers(60.0)  # hold the manifest on the wire
+    for _ in range(50):
+        router.step()
+        clock.tick(0.01)
+        if router.transfer_summary()["in_flight"]:
+            break
+    assert router.transfer_summary()["in_flight"] == 1
+    out = router.drop_transfers()
+    assert out["dropped"] == 1
+    assert router.requests_lost == 0
+    assert router.requests_requeued == 1
+    clock.tick(120.0)
+    _drain(router)
+    base = _run_colocated(model, params, PROMPTS[:1])
+    assert router.result("r0") == base["r0"]
+    assert router.transfer_summary()["dropped_total"] == 1
+
+
+def test_kill_mid_transfer_requeues_parked_manifests(tiny_model):
+    """A decode replica dying with manifests parked in its inbox gives
+    those prompts back to the fleet instead of losing them."""
+    model, params = tiny_model
+    clock = FakeClock()
+    router, plane = _disagg_fleet(
+        model, params, clock=clock, n_prefill=1, n_decode=2
+    )
+    for i, p in enumerate(PROMPTS):
+        router.add_request(p, max_new_tokens=6, request_id=f"r{i}")
+    for _ in range(30):
+        router.step()
+        clock.tick(0.01)
+        if router.transfers_delivered_total:
+            break
+    victim = router.transfer_record(
+        next(
+            rid for rid in ("r0", "r1", "r2", "r3")
+            if router.transfer_record(rid)
+        )
+    )["dst"]
+    router.kill(victim)
+    _drain(router)
+    base = _run_colocated(model, params, PROMPTS)
+    for rid in base:
+        got = router.result(rid)
+        # seated decodes on the victim died with it (counted as lost);
+        # everything that re-ran must still be bitwise-correct
+        assert got is None or got == base[rid]
+    assert router.transfer_summary()["in_flight"] == 0
+
+
+# ---------------------------------------------------------------------- #
+# fault grammar + chaos
+# ---------------------------------------------------------------------- #
+def test_fault_grammar_accepts_transfer_actions():
+    spec = FaultSpec.parse("transfer_stall@3:secs=2:replica=1")
+    assert spec.action == "transfer_stall"
+    assert spec.stall_secs == 2.0 and spec.replica == 1
+    spec = FaultSpec.parse("transfer_drop@5")
+    assert spec.action == "transfer_drop" and spec.replica is None
+    with pytest.raises(ValueError, match="secs"):
+        FaultSpec.parse("transfer_drop@5:secs=2")
+
+
+def test_chaos_transfer_actions_fire_against_disagg_fleet(tiny_model):
+    from accelerate_tpu.loadgen.chaos import ChaosAdapter
+
+    model, params = tiny_model
+    clock = FakeClock()
+    router, plane = _disagg_fleet(
+        model, params, clock=clock, n_prefill=1, n_decode=1
+    )
+    injector = FaultInjector([], rank=0, generation=0)
+    chaos = ChaosAdapter(router, injector, clock)
+    injector.specs = [FaultSpec.parse("transfer_stall@0:secs=3:replica=0")]
+    injector.maybe_fire(0)
+    (event,) = [e for e in chaos.events if e["action"] == "transfer_stall"]
+    assert event["secs"] == 3.0 and event["replica"] == "p0"
+    assert router.transfer_summary()["stalls_total"] == 1
+    injector.specs = [FaultSpec.parse("transfer_drop@1")]
+    injector.maybe_fire(1)
+    (event,) = [e for e in chaos.events if e["action"] == "transfer_drop"]
+    assert event["dropped"] == 0  # nothing in flight yet: still bounded
+
+
+def test_chaos_transfer_actions_skip_plain_engine(tiny_model):
+    """New SERVING_ACTIONS must not break existing soaks: ChaosAdapter
+    installs the transfer handlers against ANY engine and they skip
+    inert (with an event) when the engine is not a disagg router."""
+    from accelerate_tpu.loadgen.chaos import ChaosAdapter
+
+    model, params = tiny_model
+    eng = _engine(model, params)
+    injector = FaultInjector([], rank=0, generation=0)
+    chaos = ChaosAdapter(eng, injector, FakeClock())  # must not raise
+    injector.specs = [
+        FaultSpec.parse("transfer_stall@0:secs=1"),
+        FaultSpec.parse("transfer_drop@0"),
+    ]
+    injector.maybe_fire(0)
+    skips = [e for e in chaos.events if e.get("skipped")]
+    assert len(skips) == 2
+    assert all(e["skipped"] == "not_a_disagg_fleet" for e in skips)
+
+
+# ---------------------------------------------------------------------- #
+# HTTPReplica digest degradation (bugfix satellite)
+# ---------------------------------------------------------------------- #
+def test_http_digest_degrades_to_empty_instead_of_raising():
+    rep = HTTPReplica("r0", "http://127.0.0.1:1", timeout_s=0.05)
+    digest = rep.fetch_digest(16)  # connection refused: must NOT raise
+    assert digest["entries"] == []
+    assert digest["block_size"] == 0 and digest["fingerprint"] == ""
+    assert digest["stale"] is True
+    assert rep.digest_failures_total == 1
+    rep.fetch_digest(16)
+    assert rep.digest_failures_total == 2
+
+
+def test_router_prefers_last_known_digest_over_degraded():
+    class Rep:
+        name = "r0"
+        alive = True
+        draining = False
+
+        def __init__(self):
+            self.good = True
+
+        def fetch_digest(self, max_entries):
+            if self.good:
+                return {
+                    "entries": ["aa"], "block_size": 4, "fingerprint": "fp",
+                }
+            return {
+                "entries": [], "block_size": 0, "fingerprint": "",
+                "stale": True,
+            }
+
+    clock = FakeClock()
+    router = FleetRouter(now=clock, digest_max_age_s=0.0)
+    rep = Rep()
+    router.register(rep)
+    assert router._digest(rep)["keys"] == {"aa"}
+    rep.good = False
+    clock.tick(1.0)
+    # the degraded empty digest must not wipe the cached warm view
+    assert router._digest(rep)["keys"] == {"aa"}
