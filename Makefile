@@ -36,12 +36,12 @@ FAST_FILES = \
   tests/test_prefix_cache.py tests/test_speculation.py \
   tests/test_profiling.py tests/test_loadgen.py \
   tests/test_capacity.py tests/test_router.py \
-  tests/test_disagg.py
+  tests/test_disagg.py tests/test_hlo_audit.py
 
 .PHONY: test test-fast test-cold compile-cache-smoke ckpt-smoke accum-smoke \
   diag-smoke bench-fast-smoke serve-smoke serve-obs-smoke elastic-smoke \
   slice-smoke kernels-smoke lora-smoke prefix-smoke spec-smoke mem-smoke \
-  soak-smoke capacity-smoke router-smoke disagg-smoke
+  soak-smoke capacity-smoke router-smoke disagg-smoke audit-smoke
 
 test:
 	$(PYTEST) tests/ -q
@@ -233,6 +233,16 @@ router-smoke:
 # decode disturbed, measured recovery
 disagg-smoke:
 	JAX_PLATFORMS=cpu $(PYTEST) -q tests/test_disagg.py
+
+# sharding X-ray acceptance on CPU (~20s): the paged decode and the
+# spec-verify program compile collective-CLEAN under fsdp weight
+# sharding on a 4-device CPU mesh (zero involuntary reshards — the
+# CPU-feasible half of ROADMAP (a)), with the mis-pinned-sharding
+# fixture as preflight proving the detector actually fires
+audit-smoke:
+	JAX_PLATFORMS=cpu $(PYTEST) -q \
+	  tests/test_hlo_audit.py::test_mis_pinned_sharding_trips_violation \
+	  tests/test_hlo_audit.py::test_audit_smoke_decode_and_verify_clean_under_fsdp
 
 # diagnostics end-to-end on CPU: a tiny train loop with an injected slow
 # step and an injected NaN gradient runs with the flight recorder on,

@@ -1047,10 +1047,26 @@ class Accelerator:
             # roofline) is free here — no extra lowering or compile
             from .profiling.registry import get_program_registry
 
-            get_program_registry().register_compiled(
+            registry = get_program_registry()
+            registry.register_compiled(
                 tel_label, compiled, kind="train", compile_seconds=seconds,
                 microbatches=microbatches, dispatches=dispatches,
             )
+            # sharding X-ray: audit the compiled HLO's collectives
+            # against the layout's expected-collective contract —
+            # record-only, default-on, never fatal
+            try:
+                from .parallel.sharding import collective_contract_for_train
+
+                contract = collective_contract_for_train(
+                    getattr(self.state, "parallelism_plugin", None),
+                    self.mesh,
+                )
+                audit = registry.audit(tel_label, compiled, contract=contract)
+                if audit is not None:
+                    self.telemetry.record_audit(**audit.to_record())
+            except Exception as exc:  # noqa: BLE001 — observability never fatal
+                logger.debug(f"hlo audit({tel_label}) skipped: {exc}")
             # pre-seed the retrace detector: the first real step with
             # these shapes is a warm cache hit, not a (re)trace
             self.telemetry.detector(tel_label).check(*specs, warm_kw)

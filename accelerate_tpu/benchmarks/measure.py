@@ -953,6 +953,28 @@ def _run_serve(cfg, max_slots: int, block_size: int, n_requests: int,
         unit="tokens/s",
     )
 
+    # sharding X-ray: audit every captured serving program against the
+    # params-derived contract (replicated here ⇒ zero collectives), so
+    # collective/DCN bytes become regression-tracked BENCH axes
+    audit_fields: dict = {}
+    try:
+        from accelerate_tpu.profiling.registry import ProgramRegistry
+
+        audit_registry = ProgramRegistry()
+        engine.audit_programs(audit_registry, emit=False)
+        audit_sum = engine.audit_summary(audit_registry)
+        audit_fields = {
+            "audit_programs": audit_sum.get("num_programs_audited", 0),
+            "audit_collective_bytes": int(
+                audit_sum.get("ici_bytes_total", 0)
+                + audit_sum.get("dcn_bytes_total", 0)
+            ),
+            "audit_dcn_bytes": int(audit_sum.get("dcn_bytes_total", 0)),
+            "audit_violations": int(audit_sum.get("violations_total", 0)),
+        }
+    except Exception:  # noqa: BLE001 — observability never fatal
+        audit_fields = {}
+
     # analytic KV-cache HBM traffic per useful token (bf16 K+V)
     itemsize = 2
     bytes_per_pos = (
@@ -980,6 +1002,7 @@ def _run_serve(cfg, max_slots: int, block_size: int, n_requests: int,
             "prompt_tokens": prompt_tokens,
             "decode_retraces_after_warmup": decode_retraces,
             "prefill_traces": engine.trace_counts()["prefill"],
+            **audit_fields,
             **{
                 k: round(v, 4) if v is not None else None
                 for k, v in (

@@ -294,6 +294,35 @@ class AnomalyDetector:
         )
         return [rec] if rec else []
 
+    def observe_audit(
+        self,
+        record: dict,
+        now: Optional[float] = None,
+    ) -> list[dict]:
+        """Check one ``kind="audit"`` record (a compiled program's
+        collective inventory from the sharding X-ray): any contract
+        violation — a collective or sharding-changing copy the program's
+        layout does not explain — becomes a ``sharding_violation``
+        anomaly naming the offending HLO op. The auditor already did the
+        HLO walk and contract check; this routes the verdict into the
+        same rate-limited anomaly/capture machinery as every alarm."""
+        viols = record.get("violations") or []
+        # the collector stamps kind="audit"; a bare ProgramAudit
+        # .to_record() payload (no kind yet) is accepted too
+        if record.get("kind") not in (None, "audit") or not viols:
+            return []
+        now = time.monotonic() if now is None else now
+        first = viols[0] if isinstance(viols[0], dict) else {}
+        rec = self._fire(
+            "sharding_violation", record, now,
+            value=float(len(viols)),
+            program=str(record.get("program") or record.get("label") or ""),
+            op=str(first.get("op") or ""),
+            op_kind=str(first.get("op_kind") or ""),
+            ops=[str(v.get("op") or "") for v in viols if isinstance(v, dict)][:8],
+        )
+        return [rec] if rec else []
+
     def summary(self) -> dict:
         return {
             "anomalies": dict(self.counts),

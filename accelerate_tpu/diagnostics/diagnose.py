@@ -265,6 +265,35 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
                 "host_rss_bytes", "host_rss_peak_bytes",
             )
         }
+    # --- what did the compiler actually emit ----------------------------- #
+    # latest kind="audit" record per program per rank from each dump's
+    # flight ring: the sharding X-ray's collective inventory + contract
+    # verdict. Only present when auditing ran (default-on at warmup /
+    # capture, so normally every rank has at least the train step).
+    sharding: dict[int, dict[str, Any]] = {}
+    for rank, dump in dumps.items():
+        programs: dict[str, dict[str, Any]] = {}
+        violations: list[dict[str, Any]] = []
+        for rec in dump.get("records", []):
+            if rec.get("kind") != "audit":
+                continue
+            program = str(rec.get("program") or rec.get("label") or "?")
+            programs[program] = {  # records are in order: keep the latest
+                key: rec.get(key)
+                for key in (
+                    "num_collectives", "by_kind", "ici_bytes", "dcn_bytes",
+                    "total_bytes_moved", "contract_origin", "clean",
+                )
+            }
+            for v in rec.get("violations") or []:
+                if isinstance(v, dict):
+                    violations.append({"program": program, **v})
+        if programs:
+            sharding[rank] = {
+                "programs": programs,
+                "violations": violations,
+            }
+
     oom_report = None
     try:
         from ..profiling.oom import read_oom_report
@@ -311,6 +340,7 @@ def build_report(dir: str, stall_timeout_s: float = 300.0) -> dict:
         "memory": memory,
         "top_ops": top_ops,
         "oom_report": oom_report,
+        "sharding": sharding,
     }
 
 
@@ -638,6 +668,33 @@ def format_report(report: dict) -> str:
                     f"    device: in_use={_fmt_bytes(m.get('hbm_bytes_in_use'))} "
                     f"peak={_fmt_bytes(m.get('peak_hbm_bytes'))} "
                     f"limit={_fmt_bytes(m.get('hbm_bytes_limit'))}"
+                )
+    sharding = report.get("sharding") or {}
+    if sharding:
+        lines.append("")
+        lines.append("SHARDING (compiled-collective audit per rank):")
+        for rank in sorted(sharding):
+            entry = sharding[rank]
+            for program in sorted(entry.get("programs") or {}):
+                p = entry["programs"][program]
+                kinds = p.get("by_kind") or {}
+                kind_str = " ".join(
+                    f"{k}={n}" for k, n in sorted(kinds.items())
+                )
+                lines.append(
+                    f"  rank {rank} {program}: "
+                    f"{p.get('num_collectives') or 0} collective(s)"
+                    + (f" [{kind_str}]" if kind_str else "")
+                    + f" ici={_fmt_bytes(p.get('ici_bytes') or 0)}"
+                    f" dcn={_fmt_bytes(p.get('dcn_bytes') or 0)}"
+                    + f" contract={p.get('contract_origin') or 'n/a'}"
+                    + ("  CLEAN" if p.get("clean") else "  VIOLATIONS")
+                )
+            for v in entry.get("violations") or []:
+                lines.append(
+                    f"    VIOLATION {v.get('program')}: {v.get('op_kind')} "
+                    f"`{v.get('op')}` moved {_fmt_bytes(v.get('bytes_moved'))}"
+                    f" over {v.get('fabric')} — {v.get('reason')}"
                 )
     top_ops = report.get("top_ops")
     if top_ops:

@@ -127,6 +127,24 @@ class DiagnosticsManager:
                     if self.config.capture_on_anomaly:
                         self.capture.request("anomaly_memory_leak")
             return out
+        if kind == "audit":
+            # sharding X-ray verdicts: a compiled program whose HLO holds
+            # collectives its layout does not explain raises the same
+            # alarm machinery as every other anomaly source
+            out = []
+            if self.anomaly is not None:
+                for anom in self.anomaly.observe_audit(record):
+                    out.append(anom)
+                    self.recorder.event(
+                        "anomaly",
+                        anomaly_type=anom["anomaly_type"],
+                        value=anom.get("value"),
+                        program=anom.get("program"),
+                        op=anom.get("op"),
+                    )
+                    if self.config.capture_on_anomaly:
+                        self.capture.request("anomaly_sharding_violation")
+            return out
         if kind != "step":
             return []
 

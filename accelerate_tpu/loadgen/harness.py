@@ -565,6 +565,16 @@ class SoakHarness:
             section = tsum()
             if section:
                 report["transfer"] = section
+        # sharding X-ray: the compiled-collective audit roll-up (ICI/DCN
+        # bytes per program, violation verdicts) when audit_programs ran
+        asum = getattr(self.engine, "audit_summary", None)
+        if asum is not None:
+            try:
+                section = asum()
+            except Exception:  # noqa: BLE001 — observability never fatal
+                section = {}
+            if section:
+                report["audit"] = section
         self._emit_soak_final(report)
         if cfg.report_path:
             write_report(cfg.report_path, report)

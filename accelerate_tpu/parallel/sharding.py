@@ -375,3 +375,148 @@ def constrain(tree: Any, mesh: Mesh, spec: P) -> Any:
     return jax.tree.map(
         lambda x: jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec)), tree
     )
+
+
+# --------------------------------------------------------------------- #
+# expected-collective contracts (the sharding X-ray's ground truth)
+# --------------------------------------------------------------------- #
+def mesh_axes_of_params(params: Any) -> set:
+    """The mesh axis names any leaf of ``params`` is actually sharded
+    over (empty set = fully replicated / single device / uncommitted)."""
+    axes: set = set()
+    for leaf in jax.tree.leaves(params):
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        if spec is None:
+            continue
+        for entry in spec:
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                axes.update(str(a) for a in entry)
+            else:
+                axes.add(str(entry))
+    return axes
+
+
+def collective_contract_for_train(
+    plugin: Optional[ParallelismPlugin] = None,
+    mesh: Optional[Mesh] = None,
+) -> Any:
+    """Derive the train step's expected-collective contract from its
+    sharding layout — what the HLO auditor treats as *voluntary*.
+
+    The layout explains collectives; anything else in the compiled
+    program is an involuntary reshard. Per layout:
+
+    * pure DP (NO_SHARD, dp > 1): grad sync is ``all-reduce`` only;
+    * ZeRO-1 (SHARD_OPT): ``all-reduce`` grads + ``all-gather`` the
+      sharded optimizer update back into replicated params;
+    * ZeRO-2/3 (SHARD_GRAD_OP / FULL_SHARD / HYBRID_SHARD):
+      ``reduce-scatter`` + ``all-gather`` (+ ``all-reduce`` for scalar
+      metrics / non-tileable leaves);
+    * multi-slice meshes: the hierarchical grad path
+      (scatter-in-slice -> reduce-across -> gather-in-slice) regardless
+      of strategy — the ZeRO-2 grad-buffer pinning kicks in at > 1
+      slice even under replicated-param strategies;
+    * tp / sp / ep axes add their Megatron/ring/MoE traffic.
+
+    Returns a :class:`~accelerate_tpu.profiling.hlo_audit.CollectiveContract`.
+    """
+    from ..profiling.hlo_audit import RESHARD_COPY, CollectiveContract
+
+    shape = dict(mesh.shape) if mesh is not None else {}
+
+    def _deg(axis: str, plugin_val: int) -> int:
+        if shape:
+            return int(shape.get(axis, 1))
+        if plugin_val == -1:  # "absorb the rest": > 1 unless proven not
+            try:
+                return max(int(jax.device_count()), 1)
+            except Exception:  # noqa: BLE001
+                return 2
+        return int(plugin_val)
+
+    dp = _deg(MESH_AXIS_DATA, plugin.dp_size if plugin else -1)
+    fsdp = _deg(MESH_AXIS_FSDP, plugin.fsdp_size if plugin else 1)
+    tp = _deg(MESH_AXIS_TENSOR, plugin.tp_size if plugin else 1)
+    sp = _deg(MESH_AXIS_SEQUENCE, plugin.sp_size if plugin else 1)
+    ep = _deg("ep", plugin.ep_size if plugin else 1)
+    strategy = plugin.sharding_strategy if plugin is not None else None
+    num_slices = mesh_num_slices(mesh) if mesh is not None else 1
+
+    allowed: set = set()
+    notes: list = []
+    if dp > 1 or fsdp > 1:
+        allowed.add("all-reduce")  # grad sync + scalar metric psums
+    if fsdp > 1 and strategy in (
+        ShardingStrategy.SHARD_GRAD_OP,
+        ShardingStrategy.FULL_SHARD,
+        ShardingStrategy.HYBRID_SHARD,
+    ):
+        allowed |= {"reduce-scatter", "all-gather"}
+        notes.append("zero: grad reduce-scatter + param/opt all-gather")
+    if fsdp > 1 and strategy is ShardingStrategy.SHARD_OPT:
+        allowed.add("all-gather")
+        notes.append("zero-1: sharded opt update gathers into params")
+    if num_slices > 1 and (dp > 1 or fsdp > 1):
+        allowed |= {"reduce-scatter", "all-reduce", "all-gather"}
+        notes.append("hierarchical cross-slice grad sync")
+    if tp > 1:
+        allowed |= {"all-reduce", "all-gather", "reduce-scatter"}
+        notes.append("tensor-parallel partial sums")
+    if sp > 1:
+        allowed |= {"all-to-all", "collective-permute",
+                    "all-reduce", "all-gather"}
+        notes.append("sequence-parallel ring exchange")
+    if ep > 1:
+        allowed |= {"all-to-all", "all-reduce"}
+        notes.append("expert-parallel token routing")
+    if allowed:
+        # shard_map bodies (hierarchical psum, pipeline loop, overlap)
+        # legitimately cross the manual/auto boundary
+        allowed.add(RESHARD_COPY)
+    name = strategy.name.lower() if strategy is not None else "default"
+    origin = (
+        f"train:{name}(dp={dp},fsdp={fsdp},tp={tp},sp={sp},ep={ep},"
+        f"slices={num_slices})"
+    )
+    return CollectiveContract(
+        allowed=frozenset(allowed), origin=origin, notes=tuple(notes),
+    )
+
+
+def collective_contract_for_params(
+    params: Any, *, family: str = "serve"
+) -> Any:
+    """Derive a forward-only (serving) program's expected-collective
+    contract from how its params are *actually* sharded.
+
+    Under pure data/fsdp-replicated serving (no leaf sharded: the
+    common single-replica engine) the contract is EMPTY — the
+    decode/verify/COW/prefill-bucket programs expect zero cross-device
+    collectives, and any collective the compiler emitted is an
+    involuntary reshard. Weight-sharded layouts explain their own
+    traffic: ``fsdp`` shards gather (or partial-sum) on use, ``tp``
+    partials reduce on use. Nothing ever explains ``all-to-all`` /
+    ``collective-permute`` in a dense serving program — those stay
+    violations under every dense layout.
+    """
+    from ..profiling.hlo_audit import CollectiveContract
+
+    axes = mesh_axes_of_params(params)
+    allowed: set = set()
+    notes: list = []
+    if MESH_AXIS_FSDP in axes or MESH_AXIS_DATA in axes:
+        allowed |= {"all-gather", "all-reduce", "reduce-scatter"}
+        notes.append("weight shards gather / partial-sum on use")
+    if MESH_AXIS_TENSOR in axes:
+        allowed |= {"all-reduce", "all-gather", "reduce-scatter"}
+        notes.append("tensor-parallel partial sums reduce on use")
+    if MESH_AXIS_SEQUENCE in axes:
+        allowed |= {"all-to-all", "collective-permute"}
+    if "ep" in axes:
+        allowed |= {"all-to-all", "all-reduce"}
+    origin = f"{family}:{'+'.join(sorted(axes)) if axes else 'replicated'}"
+    return CollectiveContract(
+        allowed=frozenset(allowed), origin=origin, notes=tuple(notes),
+    )

@@ -11,6 +11,10 @@ Three host-side pieces answering "where do the bytes and FLOPs go":
 * :mod:`oom` — RESOURCE_EXHAUSTED autopsies: an atomic
   ``oom-report.json`` written from already-resident data at the
   step/engine/bench boundaries.
+* :mod:`hlo_audit` — the sharding X-ray: per-program collective
+  inventories (kind / bytes moved / ICI-vs-DCN) parsed from compiled
+  HLO, checked against each program's expected-collective contract;
+  unexplained collectives surface as ``sharding_violation`` anomalies.
 
 All default-on behavior is record-only; nothing here changes numerics
 or trace shapes (the zero-retrace contracts are asserted with the plane
@@ -18,6 +22,19 @@ enabled in ``tests/test_profiling.py``).
 """
 
 from .census import BufferCensus
+from .hlo_audit import (
+    COLLECTIVE_KINDS,
+    CONTRACT_ZERO,
+    RESHARD_COPY,
+    CollectiveContract,
+    CollectiveOp,
+    ProgramAudit,
+    audit_compiled,
+    audit_hlo_text,
+    parse_hlo_collectives,
+    parse_replica_groups,
+    summarize_audits,
+)
 from .oom import (
     ENV_OOM_DIR,
     OOM_REPORT_NAME,
@@ -36,6 +53,17 @@ from .registry import (
 
 __all__ = [
     "BufferCensus",
+    "COLLECTIVE_KINDS",
+    "CONTRACT_ZERO",
+    "RESHARD_COPY",
+    "CollectiveContract",
+    "CollectiveOp",
+    "ProgramAudit",
+    "audit_compiled",
+    "audit_hlo_text",
+    "parse_hlo_collectives",
+    "parse_replica_groups",
+    "summarize_audits",
     "ENV_OOM_DIR",
     "OOM_REPORT_NAME",
     "is_resource_exhausted",

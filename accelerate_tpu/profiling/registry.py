@@ -139,6 +139,7 @@ class ProgramRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._programs: dict[str, ProgramRecord] = {}
+        self._audits: dict[str, Any] = {}  # label -> ProgramAudit
 
     def __len__(self) -> int:
         with self._lock:
@@ -151,6 +152,7 @@ class ProgramRegistry:
     def clear(self) -> None:
         with self._lock:
             self._programs.clear()
+            self._audits.clear()
 
     def get(self, label: str) -> Optional[ProgramRecord]:
         with self._lock:
@@ -246,6 +248,66 @@ class ProgramRegistry:
         with self._lock:
             self._programs[label] = rec
         return rec
+
+    # ------------------------------------------------------------- #
+    # collective audits (the sharding X-ray)
+    # ------------------------------------------------------------- #
+    def attach_audit(self, label: str, audit: Any) -> Any:
+        """Store an already-built :class:`ProgramAudit` under ``label``
+        (idempotent — a re-audit replaces its predecessor)."""
+        with self._lock:
+            self._audits[label] = audit
+        return audit
+
+    def audit(
+        self,
+        label: str,
+        compiled: Any,
+        *,
+        contract: Any = None,
+        num_devices: Optional[int] = None,
+        num_slices: Optional[int] = None,
+    ) -> Optional[Any]:
+        """Audit one ``jax.stages.Compiled``'s HLO for collectives and
+        store the result under ``label``.
+
+        Best-effort like :meth:`register_compiled`: if the executable
+        cannot render HLO text (exotic backends), returns None and
+        stores nothing. Never raises.
+        """
+        from .hlo_audit import audit_compiled
+
+        try:
+            audit = audit_compiled(
+                label, compiled, contract=contract,
+                num_devices=num_devices, num_slices=num_slices,
+            )
+        except Exception as exc:  # noqa: BLE001 — observability never fatal
+            logger.debug(f"audit({label}) failed: {exc}")
+            return None
+        if audit is not None:
+            self.attach_audit(label, audit)
+        return audit
+
+    def get_audit(self, label: str) -> Optional[Any]:
+        with self._lock:
+            return self._audits.get(label)
+
+    def audits(self) -> dict[str, Any]:
+        with self._lock:
+            return dict(self._audits)
+
+    def audit_summary(self, labels: Optional[list] = None) -> dict:
+        """Ledger roll-up over stored audits (optionally restricted to
+        ``labels``): total/ICI/DCN bytes, violation count + details."""
+        from .hlo_audit import summarize_audits
+
+        with self._lock:
+            audits = [
+                a for lbl, a in self._audits.items()
+                if labels is None or lbl in labels
+            ]
+        return summarize_audits(audits)
 
     # ------------------------------------------------------------- #
     # queries

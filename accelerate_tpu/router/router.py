@@ -34,8 +34,9 @@ spills the session to the base policy (``session_spills_total``).
 
 from __future__ import annotations
 
+import json
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Callable, Iterable, Optional
 
 from ..serving.transfer import TransferPlane, _TransferRecord
@@ -131,6 +132,10 @@ class FleetRouter:
         # bounded per-request transfer accounting: rid -> delivery facts
         self._transfer_log: "OrderedDict[str, dict]" = OrderedDict()
         self._max_transfer_log = 65536
+        # retained hand-off timeline slices for export_trace: delivered
+        # and dropped records leave _transfers (and _transfer_log keeps
+        # only derived facts), so the fleet trace rides its own ring
+        self._transfer_trace: deque = deque(maxlen=4096)
         self._transfer_stall_until = 0.0
         self._transfer_stall_src: Optional[str] = None
         self._transfer_stall_started: Optional[float] = None
@@ -553,6 +558,16 @@ class FleetRouter:
             }
             while len(self._transfer_log) > self._max_transfer_log:
                 self._transfer_log.popitem(last=False)
+            self._transfer_trace.append({
+                "request_id": m.request_id,
+                "src": m.src,
+                "dst": rep.name,
+                "state": "delivered",
+                "started_at": rec.started_at,
+                "done_at": now,
+                "bytes": rec.moved_bytes,
+                "blocks": rec.moved_blocks,
+            })
             if self.transfer_plane is not None:
                 self.transfer_plane.record_delivery(
                     m,
@@ -572,6 +587,17 @@ class FleetRouter:
         rec.state = "dropped"
         rec.done_at = now
         self.transfers_dropped_total += 1
+        self._transfer_trace.append({
+            "request_id": rec.manifest.request_id,
+            "src": rec.manifest.src,
+            "dst": None,
+            "state": "dropped",
+            "reason": reason,
+            "started_at": rec.started_at,
+            "done_at": now,
+            "bytes": 0,
+            "blocks": 0,
+        })
         if self.transfer_plane is not None:
             self.transfer_plane.record_drop(rec.manifest, reason)
         # a TransferManifest duck-types as a Request for _requeue (same
@@ -650,6 +676,74 @@ class FleetRouter:
             "stall_recovery_s": self.transfer_stall_recovery_s,
             "replicas": per_replica,
         }
+
+    def export_trace(self, path: str) -> str:
+        """Merge every replica's span log (plus the retained KV
+        hand-off ledger slices) into ONE Chrome-trace/Perfetto JSON at
+        ``path``: a named process row per replica and a ``kv-transfer``
+        row, all referenced to the fleet's shared clock origin — a
+        disaggregated request's prefill → transfer → decode hand-off
+        reads left-to-right on a single timeline. Returns ``path``."""
+        from ..serving.spans import spans_to_chrome_trace
+
+        per_replica: list = []
+        for name, rep in self._replicas.items():
+            log = getattr(getattr(rep, "engine", None), "span_log", None)
+            if log is None:
+                continue
+            spans = list(log.closed) + log.open_spans
+            per_replica.append((name, spans))
+        origin = min(
+            [s.submit_t for _, spans in per_replica for s in spans]
+            + [t["started_at"] for t in self._transfer_trace],
+            default=0.0,
+        )
+
+        def us(t: float) -> float:
+            return (t - origin) * 1e6
+
+        events: list = []
+        for pid, (name, spans) in enumerate(per_replica):
+            events.append({
+                "ph": "M", "name": "process_name", "pid": pid,
+                "args": {"name": name},
+            })
+            payload = spans_to_chrome_trace(
+                spans, process_index=pid, time_origin=origin,
+            )
+            events.extend(payload["traceEvents"])
+        if self._transfer_trace:
+            tpid = len(per_replica)
+            events.append({
+                "ph": "M", "name": "process_name", "pid": tpid,
+                "args": {"name": "kv-transfer"},
+            })
+            for tid, t in enumerate(self._transfer_trace):
+                events.append({
+                    "ph": "M", "name": "thread_name", "pid": tpid,
+                    "tid": tid, "args": {"name": t["request_id"]},
+                })
+                slice_name = (
+                    f"transfer:{t['src']}->{t['dst']}"
+                    if t["state"] == "delivered"
+                    else f"transfer-drop:{t.get('reason')}"
+                )
+                events.append({
+                    "ph": "X", "name": slice_name, "cat": "transfer",
+                    "pid": tpid, "tid": tid,
+                    "ts": us(t["started_at"]),
+                    "dur": max(us(t["done_at"]) - us(t["started_at"]), 0.0),
+                    "args": {
+                        k: t.get(k)
+                        for k in ("request_id", "src", "dst", "state",
+                                  "bytes", "blocks")
+                    },
+                })
+        with open(path, "w") as f:
+            json.dump(
+                {"traceEvents": events, "displayTimeUnit": "ms"}, f,
+            )
+        return path
 
     def result(self, request_id: str):
         name = self._placements.get(request_id)

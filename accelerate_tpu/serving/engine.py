@@ -282,6 +282,10 @@ class ServingEngine:
         # every bucket width a prefill ever ran at — the set
         # capture_programs() reconstructs abstract specs from
         self._prefill_buckets: set[int] = set()
+        # capture_programs memoizes its AOT Compiled per label so a
+        # second capture (or the auditor) never pays a second compile
+        self._captured_programs: dict[str, Any] = {}
+        self.capture_compile_count = 0
 
         from ..models.generation import init_cache
 
@@ -1898,12 +1902,16 @@ class ServingEngine:
         snapshot = dict(self._traces)
 
         def _one(label, fn, *specs, **meta):
+            compiled = self._captured_programs.get(label)
             t0 = _time.perf_counter()
-            try:
-                compiled = fn.lower(*specs).compile()
-            except Exception as exc:  # noqa: BLE001 — partial > none
-                logger.debug(f"capture_programs({label}) failed: {exc}")
-                return
+            if compiled is None:
+                try:
+                    compiled = fn.lower(*specs).compile()
+                except Exception as exc:  # noqa: BLE001 — partial > none
+                    logger.debug(f"capture_programs({label}) failed: {exc}")
+                    return
+                self.capture_compile_count += 1
+                self._captured_programs[label] = compiled
             registry.register_compiled(
                 label, compiled, kind="serve",
                 compile_seconds=_time.perf_counter() - t0, **meta,
@@ -1967,6 +1975,60 @@ class ServingEngine:
             self._traces.clear()
             self._traces.update(snapshot)
         return labels
+
+    def audit_programs(
+        self,
+        registry: Any = None,
+        *,
+        contract: Any = None,
+        emit: bool = True,
+    ) -> dict[str, Any]:
+        """Sharding X-ray over every captured serving program: audit
+        each memoized capture-time ``Compiled``'s HLO for collectives
+        and check it against the expected-collective contract derived
+        from how the engine's params are actually sharded (replicated
+        params ⇒ decode/verify/COW/prefill expect ZERO cross-device
+        collectives).
+
+        Reuses the AOT artifacts :meth:`capture_programs` memoized — no
+        second compile, ``trace_counts()`` untouched (capture_programs
+        itself restores them). Returns ``{label: ProgramAudit}``; with
+        ``emit=True`` each audit also flows out as a ``kind="audit"``
+        telemetry record (flight ring, sinks, sharding_violation
+        anomalies)."""
+        from ..parallel.sharding import collective_contract_for_params
+        from ..profiling.registry import get_program_registry
+
+        registry = get_program_registry() if registry is None else registry
+        if not self._captured_programs:
+            self.capture_programs(registry)
+        if contract is None:
+            contract = collective_contract_for_params(
+                self.params, family="serve",
+            )
+        audits: dict[str, Any] = {}
+        for label, compiled in self._captured_programs.items():
+            audit = registry.audit(label, compiled, contract=contract)
+            if audit is None:
+                continue
+            audits[label] = audit
+            if emit:
+                self._tele("record_audit", **audit.to_record())
+        return audits
+
+    def audit_summary(self, registry: Any = None) -> dict:
+        """Roll-up of the stored serving-program audits (ICI/DCN bytes,
+        violation count + details) for soak reports and BENCH records.
+        Empty dict when :meth:`audit_programs` has not run."""
+        from ..profiling.registry import get_program_registry
+
+        registry = get_program_registry() if registry is None else registry
+        labels = [
+            lbl for lbl in registry.audits() if lbl in self._captured_programs
+        ]
+        if not labels:
+            return {}
+        return registry.audit_summary(labels)
 
     # ------------------------------------------------------------------ #
     # observability surface

@@ -583,6 +583,15 @@ class StepTelemetry:
         route to the anomaly detector like SLO breaches."""
         return self._record_event("soak", label, fields)
 
+    def record_audit(self, *, label: str = "audit", **fields) -> Optional[dict]:
+        """Emit a ``kind="audit"`` record — one compiled program's
+        collective inventory from the sharding X-ray (op counts by kind,
+        ICI/DCN bytes moved, contract origin, violations). The
+        Prometheus sink exports ``accelerate_tpu_collective_bytes
+        {program,kind,fabric}`` from it; diagnostics files any
+        violations as ``sharding_violation`` anomalies."""
+        return self._record_event("audit", label, fields)
+
     # ------------------------------------------------------------------ #
     # reporting / lifecycle
     # ------------------------------------------------------------------ #

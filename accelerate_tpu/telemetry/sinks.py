@@ -423,6 +423,11 @@ class PrometheusTextSink(TelemetrySink):
         self._summaries: dict[tuple[str, str], deque] = {}
         self._summary_counts: dict[tuple[str, str], int] = {}
         self._summary_sums: dict[tuple[str, str], float] = {}
+        # (metric, ((lname, lvalue), ...)) -> value; gauges with several
+        # label dimensions (collective_bytes{program,kind,fabric})
+        self._multi_gauges: dict[
+            tuple[str, tuple[tuple[str, str], ...]], float
+        ] = {}
 
     def emit(self, record: dict) -> None:
         kind = record.get("kind")
@@ -454,6 +459,9 @@ class PrometheusTextSink(TelemetrySink):
             key = (f"{self.prefix}_serve_preempt_total", "reason", reason)
             self._counters[key] = self._counters.get(key, 0.0) + 1.0
             self._write()
+            return
+        if kind == "audit":
+            self._emit_audit(record)
             return
         if kind == "span":
             return  # per-request traces belong in JSONL/Perfetto, not gauges
@@ -495,6 +503,29 @@ class PrometheusTextSink(TelemetrySink):
                 (f"{self.prefix}_hbm_bytes", "owner", str(owner))
             ] = float(value)
         self._emit_prefixed_gauges(record, "memory")
+
+    def _emit_audit(self, record: dict) -> None:
+        # sharding X-ray inventory: bytes moved per compiled program,
+        # collective kind and fabric —
+        # {prefix}_collective_bytes{program="serve_decode",
+        #   kind="all-gather",fabric="ici"} — plus a per-program
+        # violation-count gauge (0 = contract clean, alertable as > 0)
+        program = str(record.get("program") or record.get("label") or "")
+        for combo, value in (record.get("bytes_by_kind_fabric") or {}).items():
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            ckind, _, fabric = str(combo).partition("|")
+            self._multi_gauges[(
+                f"{self.prefix}_collective_bytes",
+                (("program", program), ("kind", ckind),
+                 ("fabric", fabric or "ici")),
+            )] = float(value)
+        viols = record.get("violations")
+        if viols is not None:
+            self._labeled_gauges[(
+                f"{self.prefix}_sharding_violations", "program", program,
+            )] = float(len(viols))
+        self._write()
 
     def _emit_slo(self, record: dict) -> None:
         label = str(record.get("label", "serve"))
@@ -570,6 +601,15 @@ class PrometheusTextSink(TelemetrySink):
                 if m == metric:
                     escaped = self._escape_label(lvalue)
                     lines.append(f'{metric}{{{lname}="{escaped}"}} {value}')
+        for metric in sorted({m for m, _ in self._multi_gauges}):
+            lines.append(f"# TYPE {metric} gauge")
+            for (m, labels), value in sorted(self._multi_gauges.items()):
+                if m == metric:
+                    inner = ",".join(
+                        f'{ln}="{self._escape_label(lv)}"'
+                        for ln, lv in labels
+                    )
+                    lines.append(f"{metric}{{{inner}}} {value}")
         for metric in sorted({m for m, _, _ in self._counters}):
             lines.append(f"# TYPE {metric} counter")
             for (m, lname, lvalue), value in sorted(self._counters.items()):

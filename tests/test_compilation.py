@@ -319,6 +319,50 @@ def test_warmup_then_first_step_never_retraces(tmp_path):
         assert "compile_time_s" not in rec
 
 
+def test_warmup_auto_audits_compiled_collectives(tmp_path):
+    # the sharding X-ray runs at warmup by default: the train step's
+    # compiled HLO is inventoried structurally (no string matching on
+    # HLO text) and checked against the layout's expected-collective
+    # contract — on the 8-way dp mesh the grad sync is explained, so
+    # the audit is clean, and the verdict rides the telemetry stream
+    from accelerate_tpu.profiling import (
+        get_program_registry,
+        reset_program_registry,
+    )
+
+    reset_program_registry()
+    jsonl = tmp_path / "telemetry.jsonl"
+    acc = _fresh_accelerator(
+        telemetry=TelemetryConfig(jsonl_path=str(jsonl))
+    )
+    ds = [{"x": np.full((2,), float(i), np.float32)} for i in range(32)]
+    loader = DataLoader(ds, batch_size=8, shuffle=False)
+    params = {"w": jnp.asarray(1.0), "b": jnp.asarray(0.5)}
+    params, opt, prepared = acc.prepare(params, optax.sgd(0.1), loader)
+    step = acc.unified_step(loss_fn, opt)
+    carry = acc.init_carry(params, opt)
+    acc.warmup(step, carry, prepared)
+
+    audit = get_program_registry().get_audit(step.label)
+    assert audit is not None
+    assert audit.contract is not None
+    assert audit.contract.origin.startswith("train:")
+    # every collective the compiler emitted is explained by the layout
+    assert audit.violations == []
+    assert audit.clean
+    for op in audit.collectives:
+        assert audit.contract.permits(op.kind)
+        assert op.fabric in ("ici", "dcn")
+    # the verdict landed in the telemetry stream as a kind="audit" record
+    lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    audit_recs = [l for l in lines if l["kind"] == "audit"]
+    assert len(audit_recs) == 1
+    assert audit_recs[0]["program"] == step.label
+    assert audit_recs[0]["clean"] is True
+    assert audit_recs[0]["violations"] == []
+    reset_program_registry()
+
+
 def test_warmup_matches_unwarmed_numerics():
     ds = [{"x": np.full((2,), float(i), np.float32)} for i in range(32)]
 

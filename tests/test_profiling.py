@@ -700,6 +700,20 @@ def test_engine_capture_programs_registers_without_new_traces(
     assert dec.argument_bytes > 0
     pre = next(l for l in labels if l.startswith("serve_prefill_b"))
     assert reg.get(pre).meta["bucket"] >= 4
+    # the Compiled artifacts are memoized: a second capture reuses every
+    # one of them (zero fresh compiles) and the counters still hold
+    compiles_after_first = engine.capture_compile_count
+    assert compiles_after_first == len(labels)
+    labels2 = engine.capture_programs(reg)
+    assert labels2 == labels
+    assert engine.capture_compile_count == compiles_after_first
+    assert dict(engine.trace_counts()) == counts_before
+    # ... and the auditor reuses the same capture-time artifacts too:
+    # auditing adds no compiles and leaves the trace counters untouched
+    audits = engine.audit_programs(reg, emit=False)
+    assert set(audits) == set(labels)
+    assert engine.capture_compile_count == compiles_after_first
+    assert dict(engine.trace_counts()) == counts_before
 
 
 # --------------------------------------------------------------------- #

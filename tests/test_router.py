@@ -701,3 +701,74 @@ def test_fleet_e2e_affinity_beats_round_robin(tiny_model):
     # zero decode retraces on every replica: one compiled decode each
     # (0 allowed only for a replica that never decoded)
     assert all(d <= 1 for d in af_decodes + rr_decodes)
+
+
+# ---------------------------------------------------------------------- #
+# export_trace: one merged Perfetto timeline for the whole fleet
+# ---------------------------------------------------------------------- #
+def test_export_trace_merges_replica_rows_and_transfer_ledger(tmp_path):
+    """Per-replica span logs and the KV hand-off ledger land in ONE
+    Chrome-trace JSON: a named process row per replica, a kv-transfer
+    row, and every slice referenced to the fleet's shared time origin
+    (so a prefill -> transfer -> decode hand-off reads left-to-right)."""
+    from accelerate_tpu.serving.spans import SpanLog
+
+    router, engines, clock = _fleet(n=2)
+    # give the fakes real span logs with one finished request each,
+    # deliberately offset so the shared origin is r0's submit (t=2.0)
+    for i, eng in enumerate(engines):
+        log = SpanLog()
+        t0 = 2.0 + i
+        log.on_submit(f"req-{i}", t0, prompt_tokens=8)
+        log.on_admit(f"req-{i}", t0 + 0.1)
+        log.on_prefill(f"req-{i}", t0 + 0.1)
+        log.on_first_token(f"req-{i}", t0 + 0.3)
+        log.on_finish(f"req-{i}", t0 + 0.5, new_tokens=4)
+        eng.span_log = log
+    # the hand-off ledger shape _deliver()/_drop_record() retain
+    router._transfer_trace.append({
+        "request_id": "req-0", "src": "r0", "dst": "r1",
+        "state": "delivered", "started_at": 2.4, "done_at": 2.6,
+        "bytes": 4096, "blocks": 2,
+    })
+    router._transfer_trace.append({
+        "request_id": "req-x", "src": "r0", "dst": None,
+        "state": "dropped", "reason": "dst_dead", "started_at": 3.0,
+        "done_at": 3.0, "bytes": 0, "blocks": 0,
+    })
+
+    path = router.export_trace(str(tmp_path / "fleet.json"))
+    with open(path) as f:
+        payload = json.load(f)
+    events = payload["traceEvents"]
+
+    rows = {
+        e["args"]["name"]: e["pid"]
+        for e in events if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert set(rows) == {"r0", "r1", "kv-transfer"}
+    assert len(set(rows.values())) == 3  # distinct pids, distinct rows
+
+    slices = [e for e in events if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in slices}
+    xfer = by_name["transfer:r0->r1"]
+    assert xfer["pid"] == rows["kv-transfer"]
+    assert xfer["args"]["bytes"] == 4096 and xfer["args"]["blocks"] == 2
+    drop = by_name["transfer-drop:dst_dead"]
+    assert drop["dur"] == 0.0
+    # shared origin: earliest submit (2.0) maps to ts=0, the transfer
+    # start 0.4s later lands at 400000us on the SAME clock
+    assert min(e["ts"] for e in slices) == 0.0
+    assert xfer["ts"] == pytest.approx(0.4e6)
+    # replica phase slices made it over via spans_to_chrome_trace
+    assert {"queue", "prefill", "decode"} <= {
+        e["name"] for e in slices if e["pid"] in (rows["r0"], rows["r1"])
+    }
+
+
+def test_export_trace_empty_fleet_writes_valid_json(tmp_path):
+    router, engines, clock = _fleet(n=2)  # fakes expose no span_log
+    path = router.export_trace(str(tmp_path / "empty.json"))
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["traceEvents"] == []

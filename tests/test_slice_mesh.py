@@ -144,12 +144,27 @@ def test_hierarchical_psum_matches_flat_psum(monkeypatch):
 
 
 def test_hierarchical_psum_lowers_to_reduce_scatter(monkeypatch):
+    # structured auditor inventory instead of HLO-text string matching:
+    # the hierarchical path must lower to reduce-scatter + all-gather
+    # (plus the cross-slice reduction), with the in-slice legs on ICI
+    # and cross-slice traffic attributed to DCN under the slice-major
+    # device assignment
+    from accelerate_tpu.profiling import audit_compiled
+
     mesh = _hier_mesh(monkeypatch)
     _, hier = _psum_fns(mesh)
     x = jnp.zeros((32, 3), jnp.float32)
-    text = jax.jit(hier).lower(x).compile().as_text()
-    assert "reduce-scatter" in text
-    assert "all-gather" in text
+    compiled = jax.jit(hier).lower(x).compile()
+    audit = audit_compiled("hier_psum", compiled, num_slices=2)
+    kinds = set(audit.by_kind)
+    assert {"reduce-scatter", "all-gather"} <= kinds
+    # every collective's bytes estimate is positive and attributed
+    for op in audit.collectives:
+        if op.kind in ("reduce-scatter", "all-gather", "all-reduce"):
+            assert op.bytes_moved > 0
+            assert op.fabric in ("ici", "dcn")
+    # the in-slice scatter/gather legs stay on ICI
+    assert audit.ici_bytes > 0
 
 
 def test_hierarchical_psum_fallback_when_rows_do_not_tile(monkeypatch):
